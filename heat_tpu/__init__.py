@@ -1,0 +1,42 @@
+"""heat_tpu — a TPU-native distributed n-dimensional tensor framework.
+
+Brand-new implementation of the capabilities of Heat (Helmholtz Analytics
+Toolkit): NumPy-like distributed arrays with a single ``split`` axis, realized
+as globally-sharded ``jax.Array``s over a device mesh; XLA/GSPMD inserts the
+collectives the reference hand-codes over MPI. See SURVEY.md for the blueprint.
+"""
+
+from .core import *
+from .core import linalg
+from .core import (
+    arithmetics,
+    base,
+    communication,
+    complex_math,
+    constants,
+    devices,
+    exponential,
+    factories,
+    logical,
+    memory,
+    printing,
+    relational,
+    rounding,
+    sanitation,
+    stride_tricks,
+    trigonometrics,
+    types,
+    version,
+)
+from .core.version import __version__
+
+
+def __getattr__(name):
+    # Lazy singletons: constructing them initializes the JAX backend, which
+    # must not happen at import time (users/tests may flip platforms first).
+    if name in ("MPI_WORLD", "MESH_WORLD"):
+        return communication.get_comm()
+    if name in ("MPI_SELF", "MESH_SELF"):
+        communication.get_comm()
+        return communication.MESH_SELF
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

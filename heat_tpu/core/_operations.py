@@ -1,0 +1,217 @@
+"""Generic operator engines.
+
+TPU-native re-design of reference heat/core/_operations.py: the four private
+generics every operator routes through. The reference implements split
+dominance + redistribution (:151-176), neutral-element fills for empty shards
+(:425-434), Allreduce for cross-split reductions (:463-468) and Exscan-based
+cumops (:268-295) by hand; here local compute *and* collectives are a single
+``jnp`` call on globally-sharded arrays — GSPMD inserts the psum/resharding —
+and the engine's job shrinks to dtype promotion, split bookkeeping and output
+sharding constraints.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import devices, sanitation, types
+from .communication import sanitize_comm
+from .dndarray import DNDarray, _ensure_split
+from .stride_tricks import broadcast_shape, sanitize_axis
+
+__all__ = []  # private module, mirrors the reference
+
+
+def _as_operand(x, comm, device):
+    """Normalize scalars / numpy / DNDarray to (jax_array_or_scalar, split)."""
+    if isinstance(x, DNDarray):
+        return x.larray, x.split
+    if isinstance(x, (int, float, bool, complex, np.number, np.bool_)):
+        return x, None
+    if isinstance(x, (np.ndarray, list, tuple)):
+        return jnp.asarray(x), None
+    if isinstance(x, jax.Array):
+        return x, None
+    raise TypeError(f"unsupported operand type: {type(x)}")
+
+
+def __binary_op(
+    operation: Callable,
+    t1,
+    t2,
+    out: Optional[DNDarray] = None,
+    where=None,
+    fn_kwargs: Optional[dict] = None,
+) -> DNDarray:
+    """Generic distributed binary operation (reference _operations.py:24-205).
+
+    Split dominance: the result is distributed along the first operand's split
+    if set, else the second's (:151-172); operands under other layouts are
+    resharded by XLA during the op itself.
+    """
+    fn_kwargs = fn_kwargs or {}
+    if not isinstance(t1, DNDarray) and not isinstance(t2, DNDarray):
+        raise TypeError(f"Only DNDarrays and numeric scalars are supported, but input was {type(t1)}, {type(t2)}")
+    ref = t1 if isinstance(t1, DNDarray) else t2
+    comm, device = ref.comm, ref.device
+
+    a, s1 = _as_operand(t1, comm, device)
+    b, s2 = _as_operand(t2, comm, device)
+
+    # dtype promotion (reference _operations.py:87): operands are cast to the
+    # promoted type BEFORE the op so op-induced promotion (e.g. true_divide of
+    # integers -> float) is preserved rather than clobbered afterwards.
+    out_dtype = types.result_type(t1, t2)
+    jt = out_dtype.jax_type()
+    a = jnp.asarray(a, dtype=jt)
+    b = jnp.asarray(b, dtype=jt)
+
+    # shape check for error parity (reference _operations.py:110-122)
+    sh1 = tuple(getattr(t1, "shape", np.shape(a) if not np.isscalar(a) else ()))
+    sh2 = tuple(getattr(t2, "shape", np.shape(b) if not np.isscalar(b) else ()))
+    out_shape = broadcast_shape(sh1, sh2)
+
+    # split dominance (reference _operations.py:151-172), adjusted for broadcast offset
+    def _bcast_split(split, shape):
+        if split is None:
+            return None
+        return split + (len(out_shape) - len(shape))
+
+    out_split = _bcast_split(s1, sh1)
+    if out_split is None:
+        out_split = _bcast_split(s2, sh2)
+
+    result = operation(a, b, **fn_kwargs)
+    if where is not None:
+        w = where.larray if isinstance(where, DNDarray) else jnp.asarray(where)
+        base = out.larray if out is not None else jnp.zeros(out_shape, result.dtype)
+        result = jnp.where(w, result, base)
+
+    if out_split is not None and out_split >= result.ndim:
+        out_split = None
+    result = _ensure_split(result, out_split, comm)
+    wrapped = DNDarray(
+        result, tuple(result.shape), types.canonical_heat_type(result.dtype), out_split, device, comm
+    )
+    if out is not None:
+        sanitation.sanitize_out(out, out_shape, out_split, device)
+        out._replace(result.astype(out.dtype.jax_type()), out_split)
+        return out
+    return wrapped
+
+
+def __local_op(
+    operation: Callable,
+    x: DNDarray,
+    out: Optional[DNDarray] = None,
+    no_cast: bool = False,
+    **kwargs,
+) -> DNDarray:
+    """Generic elementwise operation with no communication (reference
+    _operations.py:305-376). Promotes exact types to floating unless
+    ``no_cast``."""
+    sanitation.sanitize_in(x)
+    arr = x.larray
+    if not no_cast and types.heat_type_is_exact(x.dtype):
+        target = types.promote_types(x.dtype, types.float32)
+        arr = arr.astype(target.jax_type())
+    result = operation(arr, **kwargs)
+    result = _ensure_split(result, x.split if result.ndim == x.ndim else None, x.comm)
+    wrapped = DNDarray(
+        result,
+        tuple(result.shape),
+        types.canonical_heat_type(result.dtype),
+        x.split if result.ndim == x.ndim else None,
+        x.device,
+        x.comm,
+    )
+    if out is not None:
+        sanitation.sanitize_out(out, wrapped.shape, wrapped.split, wrapped.device)
+        out._replace(result.astype(out.dtype.jax_type()), wrapped.split)
+        return out
+    return wrapped
+
+
+def __reduce_op(
+    partial_op: Callable,
+    x: DNDarray,
+    axis: Optional[Union[int, Tuple[int, ...]]],
+    out: Optional[DNDarray] = None,
+    keepdims: bool = False,
+    dtype=None,
+    initial=None,
+    **kwargs,
+) -> DNDarray:
+    """Generic distributed reduction (reference _operations.py:393-505).
+
+    The reference runs the local partial op then an Allreduce when the split
+    axis is reduced (:463-468); here one ``jnp`` reduction over the sharded
+    global array lets XLA choose the partial-reduce + psum schedule.
+    """
+    sanitation.sanitize_in(x)
+    axis = sanitize_axis(x.shape, axis)
+    result = partial_op(
+        x.larray, axis=axis, keepdims=keepdims, **kwargs
+    )
+    if dtype is not None:
+        result = result.astype(types.canonical_heat_type(dtype).jax_type())
+
+    # split bookkeeping (reference _operations.py:470-490)
+    split = x.split
+    if split is None or axis is None:
+        out_split = None
+    else:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        if split in axes:
+            out_split = None
+        elif keepdims:
+            out_split = split
+        else:
+            out_split = split - sum(1 for a in axes if a < split)
+    if out_split is not None and (result.ndim == 0 or out_split >= result.ndim):
+        out_split = None
+    result = _ensure_split(result, out_split, x.comm)
+    wrapped = DNDarray(
+        result,
+        tuple(result.shape),
+        types.canonical_heat_type(result.dtype),
+        out_split,
+        x.device,
+        x.comm,
+    )
+    if out is not None:
+        sanitation.sanitize_out(out, wrapped.shape, wrapped.split, wrapped.device)
+        out._replace(result.astype(out.dtype.jax_type()), wrapped.split)
+        return out
+    return wrapped
+
+
+def __cum_op(
+    operation: Callable,
+    x: DNDarray,
+    axis: int,
+    out: Optional[DNDarray] = None,
+    dtype=None,
+) -> DNDarray:
+    """Generic cumulative operation (reference _operations.py:208-302: local
+    cumop + Exscan + final combine; here XLA decomposes the sharded-axis scan)."""
+    sanitation.sanitize_in(x)
+    axis = sanitize_axis(x.shape, axis)
+    if not isinstance(axis, int):
+        raise TypeError("axis must be a single integer for cumulative operations")
+    result = operation(x.larray, axis=axis)
+    if dtype is not None:
+        result = result.astype(types.canonical_heat_type(dtype).jax_type())
+    result = _ensure_split(result, x.split, x.comm)
+    wrapped = DNDarray(
+        result, tuple(result.shape), types.canonical_heat_type(result.dtype), x.split, x.device, x.comm
+    )
+    if out is not None:
+        sanitation.sanitize_out(out, wrapped.shape, wrapped.split, wrapped.device)
+        out._replace(result.astype(out.dtype.jax_type()), wrapped.split)
+        return out
+    return wrapped

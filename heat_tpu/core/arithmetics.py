@@ -1,0 +1,282 @@
+"""Arithmetic operations (reference: heat/core/arithmetics.py:63-1003).
+
+Every function dispatches through the §L3 engines; distributed behavior
+(cumsum Exscan, diff neighbor exchange in the reference :224-429) is a single
+sharded ``jnp`` call here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+
+from . import types
+from ._operations import __binary_op as _binary_op
+from ._operations import __cum_op as _cum_op
+from ._operations import __local_op as _local_op
+from ._operations import __reduce_op as _reduce_op
+from .dndarray import DNDarray
+
+__all__ = [
+    "add",
+    "bitwise_and",
+    "bitwise_not",
+    "bitwise_or",
+    "bitwise_xor",
+    "copysign",
+    "cumprod",
+    "cumproduct",
+    "cumsum",
+    "diff",
+    "div",
+    "divide",
+    "divmod",
+    "floordiv",
+    "floor_divide",
+    "fmod",
+    "gcd",
+    "hypot",
+    "invert",
+    "lcm",
+    "left_shift",
+    "mod",
+    "mul",
+    "multiply",
+    "nan_to_num",
+    "nanprod",
+    "nansum",
+    "neg",
+    "negative",
+    "pos",
+    "positive",
+    "pow",
+    "power",
+    "prod",
+    "remainder",
+    "right_shift",
+    "sub",
+    "subtract",
+    "sum",
+]
+
+
+def add(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise addition (reference arithmetics.py:63)."""
+    return _binary_op(jnp.add, t1, t2, out=out, where=where)
+
+
+def sub(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise subtraction (reference arithmetics.py:905)."""
+    return _binary_op(jnp.subtract, t1, t2, out=out, where=where)
+
+
+subtract = sub
+
+
+def mul(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise multiplication (reference arithmetics.py:679)."""
+    return _binary_op(jnp.multiply, t1, t2, out=out, where=where)
+
+
+multiply = mul
+
+
+def div(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise true division (reference arithmetics.py:295)."""
+    return _binary_op(jnp.true_divide, t1, t2, out=out, where=where)
+
+
+divide = div
+
+
+def divmod(t1, t2):
+    """Simultaneous floordiv and mod (reference arithmetics.py:345)."""
+    return (floordiv(t1, t2), mod(t1, t2))
+
+
+def floordiv(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise floor division (reference arithmetics.py:430)."""
+    return _binary_op(jnp.floor_divide, t1, t2, out=out, where=where)
+
+
+floor_divide = floordiv
+
+
+def fmod(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise C-style remainder (sign of dividend) (reference arithmetics.py:470)."""
+    return _binary_op(jnp.fmod, t1, t2, out=out, where=where)
+
+
+def mod(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise Python-style modulo (sign of divisor) (reference arithmetics.py:639)."""
+    return _binary_op(jnp.mod, t1, t2, out=out, where=where)
+
+
+remainder = mod
+
+
+def pow(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise power (reference arithmetics.py:759)."""
+    return _binary_op(jnp.power, t1, t2, out=out, where=where)
+
+
+power = pow
+
+
+def neg(a, out=None) -> DNDarray:
+    """Elementwise negation (reference arithmetics.py:714)."""
+    return _local_op(jnp.negative, a, out=out, no_cast=True)
+
+
+negative = neg
+
+
+def pos(a, out=None) -> DNDarray:
+    """Elementwise unary plus (reference arithmetics.py:736)."""
+    return _local_op(jnp.positive, a, out=out, no_cast=True)
+
+
+positive = pos
+
+
+def bitwise_and(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise bitwise AND (reference arithmetics.py:103)."""
+    _check_bitwise(t1, t2)
+    return _binary_op(jnp.bitwise_and, t1, t2, out=out, where=where)
+
+
+def bitwise_or(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise bitwise OR (reference arithmetics.py:141)."""
+    _check_bitwise(t1, t2)
+    return _binary_op(jnp.bitwise_or, t1, t2, out=out, where=where)
+
+
+def bitwise_xor(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise bitwise XOR (reference arithmetics.py:179)."""
+    _check_bitwise(t1, t2)
+    return _binary_op(jnp.bitwise_xor, t1, t2, out=out, where=where)
+
+
+def _check_bitwise(*ops):
+    for op in ops:
+        dt = op.dtype if isinstance(op, DNDarray) else types.heat_type_of(op)
+        if not (types.issubdtype(dt, types.integer) or types.issubdtype(dt, types.bool)):
+            raise TypeError("Operation is not supported for float types")
+
+
+def invert(a, out=None) -> DNDarray:
+    """Elementwise bitwise NOT (reference arithmetics.py:521)."""
+    _check_bitwise(a)
+    if a.dtype is types.bool:
+        return _local_op(jnp.logical_not, a, out=out, no_cast=True)
+    return _local_op(jnp.invert, a, out=out, no_cast=True)
+
+
+bitwise_not = invert
+
+
+def left_shift(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise left bit-shift (reference arithmetics.py:558)."""
+    _check_shift(t1, t2)
+    return _binary_op(jnp.left_shift, t1, t2, out=out, where=where)
+
+
+def right_shift(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise right bit-shift (reference arithmetics.py:855)."""
+    _check_shift(t1, t2)
+    return _binary_op(jnp.right_shift, t1, t2, out=out, where=where)
+
+
+def _check_shift(t1, t2):
+    for op in (t1, t2):
+        dt = op.dtype if isinstance(op, DNDarray) else types.heat_type_of(op)
+        if types.issubdtype(dt, types.bool):
+            raise TypeError("Operation is not supported for boolean types")
+        if not types.issubdtype(dt, types.integer):
+            raise TypeError("Operation is only supported for integer types")
+
+
+def copysign(t1, t2, out=None, where=None) -> DNDarray:
+    """Magnitude of t1 with sign of t2 (reference arithmetics.py:219)."""
+    dt1 = t1.dtype if isinstance(t1, DNDarray) else types.heat_type_of(t1)
+    if types.issubdtype(dt1, types.complexfloating):
+        raise TypeError("copysign is not defined for complex types")
+    return _binary_op(jnp.copysign, t1, t2, out=out, where=where)
+
+
+def cumprod(a, axis: int, dtype=None, out=None) -> DNDarray:
+    """Cumulative product along axis (reference arithmetics.py:253; the
+    reference Exscans partial products — XLA decomposes the sharded scan)."""
+    return _cum_op(jnp.cumprod, a, axis, out=out, dtype=dtype)
+
+
+cumproduct = cumprod
+
+
+def cumsum(a, axis: int, dtype=None, out=None) -> DNDarray:
+    """Cumulative sum along axis (reference arithmetics.py:274)."""
+    return _cum_op(jnp.cumsum, a, axis, out=out, dtype=dtype)
+
+
+def diff(a, n: int = 1, axis: int = -1) -> DNDarray:
+    """n-th discrete difference (reference arithmetics.py:293-429: one-row
+    neighbor exchange over MPI; here the shifted subtraction's boundary comms
+    are XLA's)."""
+    if n == 0:
+        return a
+    if n < 0:
+        raise ValueError(f"diff requires that n be a positive number, got {n}")
+    from ._operations import __local_op as local
+
+    return _local_op(lambda x: jnp.diff(x, n=n, axis=axis), a, no_cast=True)
+
+
+def gcd(t1, t2, out=None, where=None) -> DNDarray:
+    """Greatest common divisor (reference arithmetics.py:498)."""
+    _check_shift(t1, t2)
+    return _binary_op(jnp.gcd, t1, t2, out=out, where=where)
+
+
+def hypot(t1, t2, out=None, where=None) -> DNDarray:
+    """sqrt(t1^2 + t2^2) (reference arithmetics.py:514)."""
+    dt1 = t1.dtype if isinstance(t1, DNDarray) else types.heat_type_of(t1)
+    dt2 = t2.dtype if isinstance(t2, DNDarray) else types.heat_type_of(t2)
+    for dt in (dt1, dt2):
+        if types.issubdtype(dt, types.integer) or types.issubdtype(dt, types.bool):
+            raise TypeError("hypot is not supported for integer types")
+    return _binary_op(jnp.hypot, t1, t2, out=out, where=where)
+
+
+def lcm(t1, t2, out=None, where=None) -> DNDarray:
+    """Least common multiple (reference arithmetics.py:540)."""
+    _check_shift(t1, t2)
+    return _binary_op(jnp.lcm, t1, t2, out=out, where=where)
+
+
+def nan_to_num(a, nan=0.0, posinf=None, neginf=None, out=None) -> DNDarray:
+    """Replace NaN/Inf with finite numbers (reference arithmetics.py:702)."""
+    return _local_op(
+        lambda x: jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf), a, out=out, no_cast=True
+    )
+
+
+def nanprod(a, axis=None, out=None, keepdims=False) -> DNDarray:
+    """Product ignoring NaN (reference arithmetics.py:726)."""
+    return _reduce_op(jnp.nanprod, a, axis, out=out, keepdims=keepdims)
+
+
+def nansum(a, axis=None, out=None, keepdims=False) -> DNDarray:
+    """Sum ignoring NaN (reference arithmetics.py:745)."""
+    return _reduce_op(jnp.nansum, a, axis, out=out, keepdims=keepdims)
+
+
+def prod(a, axis=None, out=None, keepdims=False) -> DNDarray:
+    """Product of elements over axis (reference arithmetics.py:803)."""
+    return _reduce_op(jnp.prod, a, axis, out=out, keepdims=keepdims)
+
+
+def sum(a, axis=None, out=None, keepdims=False) -> DNDarray:
+    """Sum of elements over axis (reference arithmetics.py:946; cross-split
+    reduction is the reference's Allreduce, here an XLA psum)."""
+    return _reduce_op(jnp.sum, a, axis, out=out, keepdims=keepdims)

@@ -1,0 +1,40 @@
+"""Complex number operations (reference: heat/core/complex_math.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import types
+from ._operations import __local_op as _local_op
+from .dndarray import DNDarray
+
+__all__ = ["angle", "conj", "conjugate", "imag", "real"]
+
+
+def angle(x, deg: bool = False, out=None) -> DNDarray:
+    """Argument of complex values (reference complex_math.py:14)."""
+    return _local_op(lambda a: jnp.angle(a, deg=deg), x, out=out, no_cast=True)
+
+
+def conjugate(x, out=None) -> DNDarray:
+    """Elementwise complex conjugate (reference complex_math.py:58)."""
+    return _local_op(jnp.conjugate, x, out=out, no_cast=True)
+
+
+conj = conjugate
+
+
+def imag(x) -> DNDarray:
+    """Imaginary part (reference complex_math.py:96)."""
+    if not types.heat_type_is_complexfloating(x.dtype):
+        from . import factories
+
+        return factories.zeros_like(x)
+    return _local_op(jnp.imag, x, no_cast=True)
+
+
+def real(x) -> DNDarray:
+    """Real part (reference complex_math.py:124)."""
+    if not types.heat_type_is_complexfloating(x.dtype):
+        return x
+    return _local_op(jnp.real, x, no_cast=True)

@@ -1,0 +1,78 @@
+"""Exponential and logarithmic functions (reference: heat/core/exponential.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._operations import __binary_op as _binary_op
+from ._operations import __local_op as _local_op
+from .dndarray import DNDarray
+
+__all__ = [
+    "exp",
+    "exp2",
+    "expm1",
+    "log",
+    "log10",
+    "log1p",
+    "log2",
+    "logaddexp",
+    "logaddexp2",
+    "sqrt",
+    "square",
+]
+
+
+def exp(x, out=None) -> DNDarray:
+    """Elementwise e**x (reference exponential.py:14)."""
+    return _local_op(jnp.exp, x, out=out)
+
+
+def exp2(x, out=None) -> DNDarray:
+    """Elementwise 2**x (reference exponential.py:64)."""
+    return _local_op(jnp.exp2, x, out=out)
+
+
+def expm1(x, out=None) -> DNDarray:
+    """Elementwise e**x - 1 (reference exponential.py:39)."""
+    return _local_op(jnp.expm1, x, out=out)
+
+
+def log(x, out=None) -> DNDarray:
+    """Natural logarithm (reference exponential.py:89)."""
+    return _local_op(jnp.log, x, out=out)
+
+
+def log2(x, out=None) -> DNDarray:
+    """Base-2 logarithm (reference exponential.py:142)."""
+    return _local_op(jnp.log2, x, out=out)
+
+
+def log10(x, out=None) -> DNDarray:
+    """Base-10 logarithm (reference exponential.py:116)."""
+    return _local_op(jnp.log10, x, out=out)
+
+
+def log1p(x, out=None) -> DNDarray:
+    """log(1 + x) (reference exponential.py:168)."""
+    return _local_op(jnp.log1p, x, out=out)
+
+
+def logaddexp(x1, x2, out=None) -> DNDarray:
+    """log(exp(x1) + exp(x2)) (reference exponential.py:193)."""
+    return _binary_op(jnp.logaddexp, x1, x2, out=out)
+
+
+def logaddexp2(x1, x2, out=None) -> DNDarray:
+    """log2(2**x1 + 2**x2) (reference exponential.py:223)."""
+    return _binary_op(jnp.logaddexp2, x1, x2, out=out)
+
+
+def sqrt(x, out=None) -> DNDarray:
+    """Elementwise square root (reference exponential.py:253)."""
+    return _local_op(jnp.sqrt, x, out=out)
+
+
+def square(x, out=None) -> DNDarray:
+    """Elementwise square (reference exponential.py:278)."""
+    return _local_op(jnp.square, x, out=out, no_cast=True)

@@ -1,0 +1,322 @@
+"""Array creation routines (reference: heat/core/factories.py).
+
+The reference's ``array(..., split=k)`` has each MPI rank slice its own block
+locally (factories.py:378-381) and ``is_split`` runs a neighbor-probe +
+Allreduce protocol to infer the global shape (factories.py:383-426). Under
+single-controller JAX the global array is materialized once and sharded with a
+single ``device_put`` — GSPMD scatters the blocks; ``is_split`` degenerates to
+``split`` because there is one process (documented deviation).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple, Type, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import devices, types
+from .communication import Communication, sanitize_comm
+from .dndarray import DNDarray
+from .stride_tricks import sanitize_axis, sanitize_shape
+
+__all__ = [
+    "arange",
+    "array",
+    "asarray",
+    "empty",
+    "empty_like",
+    "eye",
+    "from_partitioned",
+    "full",
+    "full_like",
+    "linspace",
+    "logspace",
+    "meshgrid",
+    "ones",
+    "ones_like",
+    "zeros",
+    "zeros_like",
+]
+
+
+def _wrap(
+    jarr: jax.Array,
+    split: Optional[int],
+    device,
+    comm: Communication,
+) -> DNDarray:
+    """Place a global jax array under the split sharding and wrap it."""
+    from .dndarray import _ensure_split
+
+    jarr = _ensure_split(jarr, split if jarr.ndim else None, comm)
+    return DNDarray(
+        jarr,
+        tuple(jarr.shape),
+        types.canonical_heat_type(jarr.dtype),
+        split if jarr.ndim else None,
+        device,
+        comm,
+    )
+
+
+def _resolve(device, comm, split: Optional[int], ndim: int):
+    device = devices.sanitize_device(device)
+    comm = sanitize_comm(comm)
+    if split is not None:
+        split = sanitize_axis((0,) * max(ndim, 1), split)
+    return device, comm, split
+
+
+def array(
+    obj,
+    dtype=None,
+    copy: Optional[bool] = True,
+    ndmin: int = 0,
+    order: str = "C",
+    split: Optional[int] = None,
+    is_split: Optional[int] = None,
+    device=None,
+    comm: Optional[Communication] = None,
+) -> DNDarray:
+    """Create a DNDarray (reference factories.py:150-431).
+
+    ``is_split`` is accepted for compatibility; with a single controller the
+    local shard *is* the global array, so it behaves like ``split``.
+    """
+    if split is not None and is_split is not None:
+        raise ValueError(f"split and is_split are mutually exclusive parameters")
+    if is_split is not None:
+        split = is_split
+    if isinstance(obj, DNDarray):
+        if dtype is None and split == obj.split and copy is not True:
+            return obj
+        jarr = obj.larray
+        if dtype is not None:
+            jarr = jarr.astype(types.canonical_heat_type(dtype).jax_type())
+        device = obj.device if device is None else devices.sanitize_device(device)
+        comm = obj.comm if comm is None else sanitize_comm(comm)
+        if split is None and is_split is None:
+            split = obj.split
+        split = sanitize_axis(jarr.shape, split) if split is not None else None
+        return _wrap(jarr, split, device, comm)
+
+    jdtype = types.canonical_heat_type(dtype).jax_type() if dtype is not None else None
+    if isinstance(obj, jax.Array):
+        jarr = obj.astype(jdtype) if jdtype is not None else obj
+    else:
+        try:
+            nparr = np.asarray(obj, order=order)
+        except ValueError as e:
+            raise ValueError(f"invalid data: {e}")
+        if nparr.dtype == object:
+            raise TypeError("invalid data of type object")
+        if jdtype is None and nparr.dtype == np.float64 and not isinstance(obj, np.ndarray):
+            # python floats default to the framework's working precision
+            # (reference factories.py:334-340 via torch's float32 default)
+            nparr = nparr.astype(np.float32)
+        jarr = jnp.asarray(nparr, dtype=jdtype)
+    while jarr.ndim < ndmin:
+        jarr = jarr[None]
+    split = sanitize_axis(jarr.shape, split) if split is not None else None
+    device, comm, _ = _resolve(device, comm, None, jarr.ndim)
+    return _wrap(jarr, split, device, comm)
+
+
+def asarray(obj, dtype=None, copy=None, order="C", is_split=None, device=None) -> DNDarray:
+    """Convert input to a DNDarray without copying when possible
+    (reference factories.py:520)."""
+    if isinstance(obj, DNDarray) and dtype is None and copy is not True:
+        return obj
+    return array(obj, dtype=dtype, copy=copy, order=order, is_split=is_split, device=device)
+
+
+def __factory(shape, dtype, split, fill, device, comm, order="C") -> DNDarray:
+    shape = sanitize_shape(shape)
+    dtype = types.canonical_heat_type(dtype)
+    split = sanitize_axis(shape, split)
+    device, comm, _ = _resolve(device, comm, None, len(shape))
+    eff_split = split if len(shape) else None
+    if eff_split is not None and shape[eff_split] % comm.size == 0:
+        sharding = comm.sharding(len(shape), eff_split)
+        jarr = jax.jit(lambda: fill(shape, dtype.jax_type()), out_shardings=sharding)()
+    else:
+        from .dndarray import _ensure_split
+
+        jarr = _ensure_split(fill(shape, dtype.jax_type()), eff_split, comm)
+    return DNDarray(jarr, shape, dtype, eff_split, device, comm)
+
+
+def empty(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """Uninitialized (here: zero-filled — XLA has no uninitialized alloc)
+    array (reference factories.py:558)."""
+    return __factory(shape, dtype, split, jnp.zeros, device, comm, order)
+
+
+def zeros(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """Array of zeros (reference factories.py:1244)."""
+    return __factory(shape, dtype, split, jnp.zeros, device, comm, order)
+
+
+def ones(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """Array of ones (reference factories.py:1072)."""
+    return __factory(shape, dtype, split, jnp.ones, device, comm, order)
+
+
+def full(shape, fill_value, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """Constant-filled array (reference factories.py:820)."""
+    if dtype is None:
+        dtype = types.heat_type_of(fill_value)
+        if isinstance(fill_value, (int, np.integer)):
+            dtype = types.float32  # numpy full semantics in the reference use float default
+    value = np.asarray(fill_value)
+    return __factory(
+        shape, dtype, split, lambda s, dt: jnp.full(s, value, dtype=dt), device, comm, order
+    )
+
+
+def __factory_like(a, dtype, split, factory, device, comm, **kwargs) -> DNDarray:
+    shape = a.shape if hasattr(a, "shape") else np.asarray(a).shape
+    if dtype is None:
+        dtype = a.dtype if isinstance(a, DNDarray) else types.heat_type_of(a)
+    if split is None:
+        split = a.split if isinstance(a, DNDarray) else None
+    if device is None and isinstance(a, DNDarray):
+        device = a.device
+    if comm is None and isinstance(a, DNDarray):
+        comm = a.comm
+    return factory(shape, dtype=dtype, split=split, device=device, comm=comm, **kwargs)
+
+
+def empty_like(a, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    return __factory_like(a, dtype, split, empty, device, comm)
+
+
+def zeros_like(a, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    return __factory_like(a, dtype, split, zeros, device, comm)
+
+
+def ones_like(a, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    return __factory_like(a, dtype, split, ones, device, comm)
+
+
+def full_like(a, fill_value, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    return __factory_like(a, dtype, split, full, device, comm, fill_value=fill_value)
+
+
+def arange(*args, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    """Evenly spaced values in [start, stop) (reference factories.py:40-138)."""
+    num_of_param = len(args)
+    if num_of_param == 1:
+        start, stop, step = 0, args[0], 1
+    elif num_of_param == 2:
+        start, stop, step = args[0], args[1], 1
+    elif num_of_param == 3:
+        start, stop, step = args
+    else:
+        raise TypeError(f"function takes minimum one and at most 3 positional arguments ({num_of_param} given)")
+    if dtype is None:
+        if all(isinstance(a, (int, np.integer)) for a in (start, stop, step)):
+            dtype = types.int32
+        else:
+            dtype = types.float32
+    dtype = types.canonical_heat_type(dtype)
+    jarr = jnp.arange(start, stop, step, dtype=dtype.jax_type())
+    device, comm, _ = _resolve(device, comm, None, 1)
+    split = sanitize_axis(jarr.shape, split) if split is not None else None
+    return _wrap(jarr, split, device, comm)
+
+
+def linspace(
+    start,
+    stop,
+    num: int = 50,
+    endpoint: bool = True,
+    retstep: bool = False,
+    dtype=None,
+    split=None,
+    device=None,
+    comm=None,
+):
+    """num evenly spaced samples over [start, stop] (reference factories.py:873)."""
+    num = int(num)
+    if num <= 0:
+        raise ValueError(f"number of samples 'num' must be non-negative integer, but was {num}")
+    start = float(start.item() if isinstance(start, DNDarray) else start)
+    stop = float(stop.item() if isinstance(stop, DNDarray) else stop)
+    jdt = types.canonical_heat_type(dtype).jax_type() if dtype is not None else None
+    jarr = jnp.linspace(start, stop, num, endpoint=endpoint, dtype=jdt)
+    device, comm, _ = _resolve(device, comm, None, 1)
+    split = sanitize_axis(jarr.shape, split) if split is not None else None
+    out = _wrap(jarr, split, device, comm)
+    if retstep:
+        # max-guard mirrors reference factories.py (num=1 would divide by zero)
+        step = (stop - start) / max(1, num - 1 if endpoint else num)
+        return out, step
+    return out
+
+
+def logspace(
+    start, stop, num=50, endpoint=True, base=10.0, dtype=None, split=None, device=None, comm=None
+) -> DNDarray:
+    """Samples on a log scale (reference factories.py:975)."""
+    y = linspace(start, stop, num=num, endpoint=endpoint, split=split, device=device, comm=comm)
+    from . import exponential
+
+    out = exponential.exp(y * float(np.log(base)))
+    if dtype is not None:
+        return out.astype(dtype)
+    return out
+
+
+def eye(shape, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """2-D identity-like array (reference factories.py:735)."""
+    if isinstance(shape, (int, np.integer)):
+        n, m = int(shape), int(shape)
+    else:
+        shape = sanitize_shape(shape)
+        if len(shape) == 1:
+            n = m = shape[0]
+        else:
+            n, m = shape[0], shape[1]
+    dtype = types.canonical_heat_type(dtype)
+    device, comm, _ = _resolve(device, comm, None, 2)
+    split = sanitize_axis((n, m), split) if split is not None else None
+    return _wrap(jnp.eye(n, m, dtype=dtype.jax_type()), split, device, comm)
+
+
+def meshgrid(*arrays, indexing: str = "xy") -> List[DNDarray]:
+    """Coordinate matrices from coordinate vectors (reference factories.py:1039).
+
+    The reference splits the output along the first/second axis according to
+    the inputs' splits; here the outputs inherit split=0 if any input is split.
+    """
+    if indexing not in ("xy", "ij"):
+        raise ValueError(f"indexing must be 'xy' or 'ij', got {indexing}")
+    if not arrays:
+        return []
+    split = None
+    comm = None
+    device = None
+    jarrs = []
+    for a in arrays:
+        if isinstance(a, DNDarray):
+            comm = comm or a.comm
+            device = device or a.device
+            if a.split is not None:
+                split = 0
+            jarrs.append(a.larray)
+        else:
+            jarrs.append(jnp.asarray(a))
+    comm = sanitize_comm(comm)
+    device = devices.sanitize_device(device)
+    outs = jnp.meshgrid(*jarrs, indexing=indexing)
+    return [_wrap(o, split, device, comm) for o in outs]
+
+
+def from_partitioned(x, comm=None) -> DNDarray:
+    """Reference factories.py supports the dask-style __partitioned__ protocol;
+    here any object exposing ``__partitioned__`` or an array interface is
+    ingested as a global array."""
+    return array(x, comm=comm)

@@ -1,0 +1,5 @@
+"""Distributed linear algebra (reference: heat/core/linalg/__init__.py)."""
+
+from .basics import *
+from .qr import *
+from .solver import *

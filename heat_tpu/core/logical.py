@@ -1,0 +1,116 @@
+"""Logical operations (reference: heat/core/logical.py:38-531).
+
+``all``/``any`` are reductions — the reference uses MPI.LAND/LOR Allreduces
+(logical.py:38-209); here they are sharded ``jnp`` reductions.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import types
+from ._operations import __binary_op as _binary_op
+from ._operations import __local_op as _local_op
+from ._operations import __reduce_op as _reduce_op
+from .dndarray import DNDarray
+
+__all__ = [
+    "all",
+    "allclose",
+    "any",
+    "isclose",
+    "isfinite",
+    "isinf",
+    "isnan",
+    "isneginf",
+    "isposinf",
+    "logical_and",
+    "logical_not",
+    "logical_or",
+    "logical_xor",
+    "signbit",
+]
+
+
+def all(x, axis=None, out=None, keepdims=False) -> DNDarray:
+    """True where all elements (over axis) are truthy (reference logical.py:38)."""
+    res = _reduce_op(jnp.all, x, axis, out=out, keepdims=keepdims)
+    return res
+
+
+def allclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> bool:
+    """Scalar closeness check (reference logical.py:96)."""
+    close = isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+    return bool(jnp.all(close.larray).item())
+
+
+def any(x, axis=None, out=None, keepdims=False) -> DNDarray:
+    """True where any element (over axis) is truthy (reference logical.py:145)."""
+    return _reduce_op(jnp.any, x, axis, out=out, keepdims=keepdims)
+
+
+def isclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> DNDarray:
+    """Elementwise closeness (reference logical.py:212)."""
+    res = _binary_op(
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y
+    )
+    return res.astype(types.bool, copy=False) if res.dtype is not types.bool else res
+
+
+def isfinite(x) -> DNDarray:
+    """Elementwise finiteness test (reference logical.py:249)."""
+    return _local_op(jnp.isfinite, x, no_cast=True)
+
+
+def isinf(x) -> DNDarray:
+    """Elementwise infinity test (reference logical.py:275)."""
+    return _local_op(jnp.isinf, x, no_cast=True)
+
+
+def isnan(x) -> DNDarray:
+    """Elementwise NaN test (reference logical.py:301)."""
+    return _local_op(jnp.isnan, x, no_cast=True)
+
+
+def isneginf(x, out=None) -> DNDarray:
+    """Elementwise -inf test (reference logical.py:327)."""
+    return _local_op(jnp.isneginf, x, out=out, no_cast=True)
+
+
+def isposinf(x, out=None) -> DNDarray:
+    """Elementwise +inf test (reference logical.py:353)."""
+    return _local_op(jnp.isposinf, x, out=out, no_cast=True)
+
+
+def logical_and(t1, t2) -> DNDarray:
+    """Elementwise logical AND (reference logical.py:379)."""
+    return _binary_op(jnp.logical_and, _bool(t1), _bool(t2))
+
+
+def logical_not(t, out=None) -> DNDarray:
+    """Elementwise logical NOT (reference logical.py:409)."""
+    return _local_op(jnp.logical_not, t, out=out, no_cast=True)
+
+
+def logical_or(t1, t2) -> DNDarray:
+    """Elementwise logical OR (reference logical.py:435)."""
+    return _binary_op(jnp.logical_or, _bool(t1), _bool(t2))
+
+
+def logical_xor(t1, t2) -> DNDarray:
+    """Elementwise logical XOR (reference logical.py:465)."""
+    return _binary_op(jnp.logical_xor, t1, t2)
+
+
+def _bool(t):
+    if isinstance(t, DNDarray) and t.dtype is not types.bool:
+        return t.astype(types.bool)
+    return t
+
+
+def signbit(x, out=None) -> DNDarray:
+    """True where the sign bit is set (reference logical.py:495)."""
+    return _local_op(jnp.signbit, x, out=out, no_cast=True)

@@ -1,0 +1,25 @@
+"""Memory operations (reference: heat/core/memory.py)."""
+
+from __future__ import annotations
+
+from .dndarray import DNDarray
+
+__all__ = ["copy", "sanitize_memory_layout"]
+
+
+def copy(a: DNDarray) -> DNDarray:
+    """Deep copy (reference memory.py:13)."""
+    if not isinstance(a, DNDarray):
+        raise TypeError(f"input needs to be a DNDarray, but was {type(a)}")
+    import jax.numpy as jnp
+
+    return DNDarray(jnp.copy(a.larray), a.gshape, a.dtype, a.split, a.device, a.comm)
+
+
+def sanitize_memory_layout(x, order: str = "C"):
+    """Memory-layout normalization (reference memory.py:42). XLA owns physical
+    layout on TPU (tiled, not strided), so 'C'/'F' requests are accepted and
+    recorded but do not transpose storage."""
+    if order not in ("C", "F"):
+        raise ValueError(f"expected order to be 'C' or 'F', but was {order}")
+    return x

@@ -1,0 +1,111 @@
+"""Distributed-aware printing (reference: heat/core/printing.py).
+
+The reference gathers only the edge items of large arrays to rank 0
+(printing.py:208-264). Under the global-view runtime a repr materializes the
+(summarized) global array on host; for very large arrays only the edge slabs
+are fetched, mirroring the reference's balanced gather.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .communication import get_comm
+
+__all__ = [
+    "get_printoptions",
+    "global_printing",
+    "local_printing",
+    "print0",
+    "set_printoptions",
+]
+
+# default print options (reference printing.py:14-27 via torch defaults)
+__PRINT_OPTIONS = dict(precision=4, threshold=1000, edgeitems=3, linewidth=120, sci_mode=None)
+__LOCAL_PRINTING = False
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None, linewidth=None, profile=None, sci_mode=None):
+    """Configure printing options (reference printing.py:150-207)."""
+    if profile == "default":
+        __PRINT_OPTIONS.update(precision=4, threshold=1000, edgeitems=3, linewidth=120)
+    elif profile == "short":
+        __PRINT_OPTIONS.update(precision=2, threshold=1000, edgeitems=2, linewidth=120)
+    elif profile == "full":
+        __PRINT_OPTIONS.update(precision=4, threshold=float("inf"), edgeitems=3, linewidth=120)
+    for key, val in dict(
+        precision=precision, threshold=threshold, edgeitems=edgeitems, linewidth=linewidth, sci_mode=sci_mode
+    ).items():
+        if val is not None:
+            __PRINT_OPTIONS[key] = val
+
+
+def get_printoptions() -> dict:
+    """View of current printing options (reference printing.py:31)."""
+    return dict(__PRINT_OPTIONS)
+
+
+def local_printing() -> None:
+    """Print only the local shard on each process (reference printing.py:30-60)."""
+    global __LOCAL_PRINTING
+    __LOCAL_PRINTING = True
+
+
+def global_printing() -> None:
+    """Restore global (gathered) printing (reference printing.py:61-99)."""
+    global __LOCAL_PRINTING
+    __LOCAL_PRINTING = False
+
+
+def print0(*args, **kwargs) -> None:
+    """Print once (process 0) (reference printing.py:100-126)."""
+    if get_comm().rank == 0:
+        print(*args, **kwargs)
+
+
+def __str__(dndarray) -> str:
+    """Global string representation (reference printing.py:208-264)."""
+    opts = __PRINT_OPTIONS
+    body = _format_data(dndarray, opts)
+    return (
+        f"DNDarray({body}, dtype=heat_tpu.{dndarray.dtype.__name__}, "
+        f"device={dndarray.device}, split={dndarray.split})"
+    )
+
+
+# above this many elements, only edge slabs are fetched from the devices
+_FULL_FETCH_LIMIT = 65536
+
+
+def _format_data(dndarray, opts) -> str:
+    """Format (the printable portion of) the array. Arrays beyond the fetch
+    limit only move their first-axis edge slabs to host — the analog of the
+    reference's balanced edge-item gather (printing.py:208-264)."""
+    threshold = opts["threshold"]
+    edge = opts["edgeitems"]
+    np_opts = dict(
+        precision=opts["precision"],
+        threshold=int(threshold) if np.isfinite(threshold) else np.iinfo(np.int64).max,
+        edgeitems=edge,
+        linewidth=opts["linewidth"],
+    )
+    arr = dndarray.larray
+    summarize_slabs = (
+        np.isfinite(threshold)
+        and dndarray.ndim >= 1
+        and dndarray.size > max(threshold, _FULL_FETCH_LIMIT)
+        and dndarray.shape[0] > 2 * edge + 1
+    )
+    with np.printoptions(**np_opts):
+        if not summarize_slabs:
+            return np.array2string(np.asarray(arr), separator=", ", prefix="DNDarray(")
+        top = np.asarray(arr[:edge])
+        bot = np.asarray(arr[-edge:])
+        if dndarray.ndim == 1:
+            items = [np.array2string(v)[:] for v in top] + ["..."] + [
+                np.array2string(v) for v in bot
+            ]
+            return "[" + ", ".join(items) + "]"
+        head = np.array2string(top, separator=", ", prefix="DNDarray(")[1:-1]
+        tail = np.array2string(bot, separator=", ", prefix="DNDarray(")[1:-1]
+        return "[" + head + ",\n ...,\n " + tail + "]"

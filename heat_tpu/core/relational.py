@@ -1,0 +1,74 @@
+"""Elementwise comparison operations (reference: heat/core/relational.py:35-420)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import types
+from ._operations import __binary_op as _binary_op
+from .dndarray import DNDarray
+
+__all__ = ["eq", "equal", "ge", "greater", "greater_equal", "gt", "le", "less", "less_equal", "lt", "ne", "not_equal"]
+
+
+def _cmp(op, t1, t2, out=None, where=None) -> DNDarray:
+    res = _binary_op(op, t1, t2, out=out, where=where)
+    if out is None and res.dtype is not types.bool:
+        return res.astype(types.bool, copy=False)
+    return res
+
+
+def eq(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise == (reference relational.py:35)."""
+    return _cmp(jnp.equal, t1, t2, out, where)
+
+
+def equal(t1, t2) -> bool:
+    """True if ALL elements equal (reference relational.py:82: reduce over eq)."""
+    from . import logical
+
+    try:
+        res = eq(t1, t2)
+    except ValueError:
+        return False
+    return bool(logical.all(res).item())
+
+
+def ge(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise >= (reference relational.py:130)."""
+    return _cmp(jnp.greater_equal, t1, t2, out, where)
+
+
+greater_equal = ge
+
+
+def gt(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise > (reference relational.py:177)."""
+    return _cmp(jnp.greater, t1, t2, out, where)
+
+
+greater = gt
+
+
+def le(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise <= (reference relational.py:225)."""
+    return _cmp(jnp.less_equal, t1, t2, out, where)
+
+
+less_equal = le
+
+
+def lt(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise < (reference relational.py:272)."""
+    return _cmp(jnp.less, t1, t2, out, where)
+
+
+less = lt
+
+
+def ne(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise != (reference relational.py:320)."""
+    return _cmp(jnp.not_equal, t1, t2, out, where)
+
+
+not_equal = ne

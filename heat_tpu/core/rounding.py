@@ -1,0 +1,91 @@
+"""Rounding and sign operations (reference: heat/core/rounding.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import types
+from ._operations import __binary_op as _binary_op
+from ._operations import __local_op as _local_op
+from .dndarray import DNDarray
+
+__all__ = ["abs", "absolute", "ceil", "clip", "fabs", "floor", "modf", "round", "sgn", "sign", "trunc"]
+
+
+def abs(x, out=None, dtype=None) -> DNDarray:
+    """Elementwise absolute value (reference rounding.py:23)."""
+    if dtype is not None and not issubclass(types.canonical_heat_type(dtype), types.generic):
+        raise TypeError("dtype must be a heat data type")
+    res = _local_op(jnp.abs, x, out=out, no_cast=True)
+    if dtype is not None and out is None:
+        res = res.astype(dtype)
+    return res
+
+
+absolute = abs
+
+
+def fabs(x, out=None) -> DNDarray:
+    """Elementwise absolute value, float result (reference rounding.py:92)."""
+    return _local_op(jnp.abs, x, out=out, no_cast=False)
+
+
+def ceil(x, out=None) -> DNDarray:
+    """Elementwise ceiling (reference rounding.py:59)."""
+    return _local_op(jnp.ceil, x, out=out)
+
+
+def clip(x, min=None, max=None, out=None) -> DNDarray:
+    """Clip values to [min, max] (reference rounding.py:118)."""
+    if min is None and max is None:
+        raise ValueError("either min or max must be set")
+    lo = min.larray if isinstance(min, DNDarray) else min
+    hi = max.larray if isinstance(max, DNDarray) else max
+    return _local_op(lambda a: jnp.clip(a, lo, hi), x, out=out, no_cast=True)
+
+
+def floor(x, out=None) -> DNDarray:
+    """Elementwise floor (reference rounding.py:151)."""
+    return _local_op(jnp.floor, x, out=out)
+
+
+def modf(x, out=None):
+    """Fractional and integral parts (reference rounding.py:177)."""
+    from .dndarray import DNDarray as D
+
+    if not isinstance(x, D):
+        raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
+    frac = _local_op(lambda a: jnp.modf(a)[0], x)
+    whole = _local_op(lambda a: jnp.modf(a)[1], x)
+    if out is not None:
+        if not isinstance(out, tuple) or len(out) != 2:
+            raise TypeError(f"expected out to be None or a tuple of two DNDarrays, but was {type(out)}")
+        out[0].larray = frac.larray
+        out[1].larray = whole.larray
+        return out
+    return (frac, whole)
+
+
+def round(x, decimals: int = 0, out=None, dtype=None) -> DNDarray:
+    """Round to given decimals (reference rounding.py:220)."""
+    res = _local_op(lambda a: jnp.round(a, decimals=decimals), x, out=out)
+    if dtype is not None and out is None:
+        res = res.astype(dtype)
+    return res
+
+
+def sgn(x, out=None) -> DNDarray:
+    """Sign, complex-aware (reference rounding.py:266)."""
+    return _local_op(jnp.sign, x, out=out, no_cast=True)
+
+
+def sign(x, out=None) -> DNDarray:
+    """Sign of elements; for complex, sign of the real part (reference rounding.py:290)."""
+    if types.heat_type_is_complexfloating(x.dtype):
+        return _local_op(lambda a: jnp.sign(a.real).astype(a.dtype), x, out=out, no_cast=True)
+    return _local_op(jnp.sign, x, out=out, no_cast=True)
+
+
+def trunc(x, out=None) -> DNDarray:
+    """Truncate toward zero (reference rounding.py:321)."""
+    return _local_op(jnp.trunc, x, out=out)

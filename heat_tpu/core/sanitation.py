@@ -1,0 +1,112 @@
+"""Input validation helpers (reference: heat/core/sanitation.py).
+
+The reference's ``sanitize_distribution`` (sanitation.py:31-158) physically
+redistributes operands to a target lshape map over MPI; under GSPMD matching
+layouts is a sharding-constraint no-op, so the helpers here focus on type and
+shape validation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from . import types
+from .dndarray import DNDarray
+from .stride_tricks import sanitize_axis, sanitize_shape
+
+__all__ = [
+    "sanitize_in",
+    "sanitize_infinity",
+    "sanitize_in_tensor",
+    "sanitize_distribution",
+    "sanitize_lshape",
+    "sanitize_out",
+    "sanitize_sequence",
+    "scalar_to_1d",
+]
+
+
+def sanitize_in(x) -> None:
+    """Raise TypeError unless ``x`` is a DNDarray (reference sanitation.py:161)."""
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+
+
+def sanitize_in_tensor(x) -> None:
+    """Raise unless x is a backend (jax) array (reference sanitation.py:178)."""
+    import jax
+
+    if not isinstance(x, jax.Array):
+        raise TypeError(f"input needs to be a jax.Array, but was {type(x)}")
+
+
+def sanitize_infinity(x) -> Union[int, float]:
+    """Largest representable value for x's dtype (reference sanitation.py:194)."""
+    dtype = x.dtype if isinstance(x, DNDarray) else types.heat_type_of(x)
+    if types.heat_type_is_exact(dtype):
+        return types.iinfo(dtype).max
+    return float("inf")
+
+
+def sanitize_distribution(*args, target: DNDarray, diff_map=None):
+    """Match operands' distribution to ``target`` (reference sanitation.py:31-158).
+
+    Under GSPMD this resplits each operand to the target's split axis; the
+    data movement is one XLA resharding collective per mismatched operand.
+    """
+    out = []
+    for x in args:
+        sanitize_in(x)
+        if x.split != target.split and x.shape == target.shape:
+            x = _resplit_copy(x, target.split)
+        out.append(x)
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def _resplit_copy(x: DNDarray, split: Optional[int]) -> DNDarray:
+    from . import factories
+
+    return factories.array(x, split=split, copy=True)
+
+
+def sanitize_lshape(array: DNDarray, tensor) -> None:
+    """Validate a local-shard shape against the global array (reference
+    sanitation.py:226). Balanced GSPMD layouts make this a metadata check."""
+    if tuple(tensor.shape) != tuple(array.lshape):
+        raise ValueError(f"local shape {tuple(tensor.shape)} does not match expected {array.lshape}")
+
+
+def sanitize_out(
+    out: DNDarray,
+    output_shape: Sequence[int],
+    output_split: Optional[int],
+    output_device,
+    output_comm=None,
+) -> None:
+    """Validate an ``out=`` buffer (reference sanitation.py:259)."""
+    if not isinstance(out, DNDarray):
+        raise TypeError(f"expected out to be None or a DNDarray, but was {type(out)}")
+    if tuple(out.shape) != tuple(output_shape):
+        raise ValueError(f"Expecting output buffer of shape {tuple(output_shape)}, got {out.shape}")
+
+
+def sanitize_sequence(seq) -> list:
+    """Normalize a sequence argument to a list (reference sanitation.py:310)."""
+    if isinstance(seq, list):
+        return seq
+    if isinstance(seq, tuple):
+        return list(seq)
+    if isinstance(seq, DNDarray):
+        return seq.tolist()
+    raise TypeError(f"seq must be a list, tuple or DNDarray, got {type(seq)}")
+
+
+def scalar_to_1d(x: DNDarray) -> DNDarray:
+    """Turn a scalar DNDarray into a 1-element 1-D one (reference sanitation.py:334)."""
+    from . import manipulations
+
+    if x.ndim == 0:
+        return manipulations.expand_dims(x, 0)
+    return x

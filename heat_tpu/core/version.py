@@ -1,0 +1,15 @@
+"""Version information (reference: heat/core/version.py:3-9)."""
+
+major: int = 0
+"""Major version number."""
+minor: int = 1
+"""Minor version number."""
+micro: int = 0
+"""Micro version number."""
+extension: str = "dev"
+"""Version extension marker."""
+
+if not extension:
+    __version__ = f"{major}.{minor}.{micro}"
+else:
+    __version__ = f"{major}.{minor}.{micro}-{extension}"

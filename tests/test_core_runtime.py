@@ -1,0 +1,272 @@
+"""Tests for the core runtime: devices, types, communication, DNDarray, factories.
+
+Model: reference heat/core/tests/{test_types,test_factories,test_dndarray,
+test_communication}.py — numpy-oracle comparisons swept over split axes.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from harness import TestCase
+
+
+class TestDevices(TestCase):
+    def test_sanitize(self):
+        self.assertEqual(ht.sanitize_device("cpu"), ht.cpu)
+        self.assertEqual(ht.sanitize_device("gpu"), ht.tpu)
+        self.assertEqual(ht.sanitize_device(None), ht.get_device())
+        with pytest.raises(ValueError):
+            ht.sanitize_device("fpga")
+
+    def test_use_device(self):
+        prev = ht.get_device()
+        ht.use_device("cpu")
+        self.assertEqual(ht.get_device(), ht.cpu)
+        ht.use_device(prev)
+
+
+class TestTypes(TestCase):
+    def test_canonical(self):
+        self.assertIs(ht.canonical_heat_type(np.float32), ht.float32)
+        self.assertIs(ht.canonical_heat_type("float32"), ht.float32)
+        self.assertIs(ht.canonical_heat_type(float), ht.float32)
+        self.assertIs(ht.canonical_heat_type(int), ht.int64)
+        self.assertIs(ht.canonical_heat_type(bool), ht.bool)
+        self.assertIs(ht.canonical_heat_type(ht.float64), ht.float64)
+        with pytest.raises(TypeError):
+            ht.canonical_heat_type("notatype")
+
+    def test_promote(self):
+        # torch/jax semantics (the reference follows torch): int + float32 -> float32
+        self.assertIs(ht.promote_types(ht.int32, ht.float32), ht.float32)
+        self.assertIs(ht.promote_types(ht.uint8, ht.int8), ht.int16)
+        self.assertIs(ht.promote_types(ht.float32, ht.float32), ht.float32)
+
+    def test_issubdtype(self):
+        self.assertTrue(ht.issubdtype(ht.float32, ht.floating))
+        self.assertTrue(ht.issubdtype(ht.int16, ht.integer))
+        self.assertFalse(ht.issubdtype(ht.float32, ht.integer))
+
+    def test_cast_call(self):
+        x = ht.float32([1, 2, 3])
+        self.assertIsInstance(x, ht.DNDarray)
+        self.assertIs(x.dtype, ht.float32)
+        with pytest.raises(TypeError):
+            ht.floating([1.0])
+
+    def test_finfo_iinfo(self):
+        self.assertEqual(ht.finfo(ht.float32).bits, 32)
+        self.assertEqual(ht.iinfo(ht.int8).max, 127)
+        with pytest.raises(TypeError):
+            ht.finfo(ht.int32)
+        with pytest.raises(TypeError):
+            ht.iinfo(ht.float32)
+
+    def test_result_type(self):
+        self.assertIs(ht.result_type(ht.zeros(3, dtype=ht.int32), 1.0), ht.float32)
+
+
+class TestCommunication(TestCase):
+    def test_world(self):
+        comm = ht.get_comm()
+        self.assertEqual(comm.size, 8)
+        self.assertTrue(comm.is_distributed())
+
+    def test_chunk(self):
+        comm = ht.get_comm()
+        offset, lshape, slices = comm.chunk((16, 4), 0, rank=0)
+        self.assertEqual(lshape, (2, 4))
+        self.assertEqual(offset, 0)
+        offset, lshape, _ = comm.chunk((16, 4), 0, rank=7)
+        self.assertEqual(offset, 14)
+        # uneven
+        counts, displs = comm.counts_displs_shape((10,), 0)
+        self.assertEqual(sum(counts), 10)
+        self.assertEqual(counts[0], 2)
+        # replicated
+        _, lshape, _ = comm.chunk((16, 4), None)
+        self.assertEqual(lshape, (16, 4))
+
+    def test_lshape_map(self):
+        comm = ht.get_comm()
+        lmap = comm.lshape_map((16, 4), 0)
+        self.assertEqual(lmap.shape, (8, 2))
+        self.assertEqual(int(lmap[:, 0].sum()), 16)
+
+
+class TestFactories(TestCase):
+    def test_array(self):
+        for split in (None, 0, 1):
+            x = ht.array(np.arange(24.0).reshape(6, 4), split=split)
+            self.assert_array_equal(x, np.arange(24.0).reshape(6, 4))
+            self.assertEqual(x.split, split)
+        # python default float -> float32
+        self.assertIs(ht.array([1.5, 2.5]).dtype, ht.float32)
+        self.assertIs(ht.array([1, 2]).dtype, ht.int64)
+        self.assertIs(ht.array([True, False]).dtype, ht.bool)
+        # dtype forcing
+        self.assertIs(ht.array([1, 2], dtype=ht.float64).dtype, ht.float64)
+        with pytest.raises(ValueError):
+            ht.array([1, 2], split=0, is_split=0)
+
+    def test_zeros_ones_full_empty(self):
+        self.assert_array_equal(ht.zeros((4, 5), split=0), np.zeros((4, 5), np.float32))
+        self.assert_array_equal(ht.ones((4, 5), split=1), np.ones((4, 5), np.float32))
+        self.assert_array_equal(ht.full((3, 3), 7.0), np.full((3, 3), 7.0, np.float32))
+        self.assertEqual(ht.empty((2, 2)).shape, (2, 2))
+        self.assertIs(ht.zeros(3, dtype=ht.int8).dtype, ht.int8)
+
+    def test_like(self):
+        x = ht.ones((4, 4), split=0, dtype=ht.float32)
+        z = ht.zeros_like(x)
+        self.assertEqual(z.split, 0)
+        self.assertIs(z.dtype, ht.float32)
+        self.assert_array_equal(z, np.zeros((4, 4), np.float32))
+        self.assert_array_equal(ht.full_like(x, 2.0), np.full((4, 4), 2.0, np.float32))
+        self.assert_array_equal(ht.empty_like(x), np.zeros((4, 4), np.float32))
+
+    def test_arange(self):
+        self.assert_array_equal(ht.arange(10), np.arange(10, dtype=np.int32))
+        self.assert_array_equal(ht.arange(2, 10), np.arange(2, 10, dtype=np.int32))
+        self.assert_array_equal(ht.arange(2, 10, 2, split=0), np.arange(2, 10, 2, dtype=np.int32))
+        self.assert_array_equal(ht.arange(0.0, 1.0, 0.25), np.arange(0, 1, 0.25, dtype=np.float32))
+        with pytest.raises(TypeError):
+            ht.arange()
+
+    def test_linspace_logspace(self):
+        self.assert_array_equal(ht.linspace(0, 1, 11), np.linspace(0, 1, 11, dtype=np.float32))
+        x, step = ht.linspace(0, 10, 5, retstep=True)
+        self.assertAlmostEqual(step, 2.5)
+        np.testing.assert_allclose(
+            ht.logspace(0, 3, 4).numpy(), np.logspace(0, 3, 4), rtol=1e-5
+        )
+        with pytest.raises(ValueError):
+            ht.linspace(0, 1, 0)
+
+    def test_eye(self):
+        self.assert_array_equal(ht.eye(4, split=0), np.eye(4, dtype=np.float32))
+        self.assert_array_equal(ht.eye((3, 5), split=1), np.eye(3, 5, dtype=np.float32))
+
+    def test_meshgrid(self):
+        a, b = ht.meshgrid(ht.arange(3), ht.arange(4, split=0))
+        na, nb = np.meshgrid(np.arange(3), np.arange(4))
+        self.assert_array_equal(a, na)
+        self.assert_array_equal(b, nb)
+        self.assertEqual(ht.meshgrid(), [])
+
+
+class TestDNDarray(TestCase):
+    def test_properties(self):
+        x = ht.array(np.arange(16.0, dtype=np.float32).reshape(4, 4), split=0)
+        self.assertEqual(x.shape, (4, 4))
+        self.assertEqual(x.gshape, (4, 4))
+        self.assertEqual(x.ndim, 2)
+        self.assertEqual(x.size, 16)
+        self.assertEqual(x.gnumel, 16)
+        self.assertTrue(x.balanced)
+        self.assertTrue(x.is_balanced())
+        self.assertEqual(x.lshape, (1, 4))
+        self.assertEqual(x.stride, (4, 1))
+        self.assertEqual(x.nbytes, 16 * 4)
+        lmap = x.lshape_map
+        self.assertEqual(int(lmap.numpy()[:, 0].sum()), 4)
+
+    def test_astype(self):
+        x = ht.arange(4, split=0)
+        y = x.astype(ht.float64)
+        self.assertIs(y.dtype, ht.float64)
+        self.assertIs(x.dtype, ht.int32)
+        x.astype(ht.float32, copy=False)
+        self.assertIs(x.dtype, ht.float32)
+
+    def test_resplit(self):
+        x = ht.array(np.arange(24.0).reshape(6, 4), split=0)
+        x.resplit_(1)
+        self.assertEqual(x.split, 1)
+        self.assert_array_equal(x, np.arange(24.0).reshape(6, 4))
+        x.resplit_(None)
+        self.assertEqual(x.split, None)
+        self.assert_array_equal(x, np.arange(24.0).reshape(6, 4))
+
+    def test_getitem(self):
+        nx = np.arange(64.0).reshape(8, 8)
+        for split in (None, 0, 1):
+            x = ht.array(nx, split=split)
+            self.assert_array_equal(x[2], nx[2])
+            self.assert_array_equal(x[1:5], nx[1:5])
+            self.assert_array_equal(x[:, 2], nx[:, 2])
+            self.assert_array_equal(x[1:5, 2:4], nx[1:5, 2:4])
+            self.assert_array_equal(x[..., 1], nx[..., 1])
+            self.assert_array_equal(x[x > 30], nx[nx > 30])
+            self.assertEqual(float(x[3, 3]), nx[3, 3])
+        # advanced indexing with arrays
+        x = ht.array(nx, split=0)
+        idx = ht.array([0, 3, 5])
+        self.assert_array_equal(x[idx], nx[[0, 3, 5]])
+
+    def test_setitem(self):
+        nx = np.arange(16.0).reshape(4, 4)
+        for split in (None, 0, 1):
+            x = ht.array(nx, split=split)
+            x[0] = 0.0
+            expected = nx.copy()
+            expected[0] = 0.0
+            self.assert_array_equal(x, expected)
+            x[1:3, 1:3] = -1.0
+            expected[1:3, 1:3] = -1.0
+            self.assert_array_equal(x, expected)
+            self.assertEqual(x.split, split)
+
+    def test_fill_diagonal(self):
+        x = ht.zeros((4, 4), split=0)
+        x.fill_diagonal(5.0)
+        self.assert_array_equal(x, np.eye(4, dtype=np.float32) * 5)
+
+    def test_scalar_conversions(self):
+        x = ht.array([3.5])
+        self.assertEqual(float(x), 3.5)
+        self.assertEqual(int(x), 3)
+        self.assertTrue(bool(ht.array([1])))
+        with pytest.raises(ValueError):
+            ht.arange(4).item()
+
+    def test_len_iter(self):
+        x = ht.arange(5, split=0)
+        self.assertEqual(len(x), 5)
+        self.assertEqual([int(v) for v in x], [0, 1, 2, 3, 4])
+
+    def test_numpy_roundtrip(self):
+        nx = np.arange(10.0)
+        x = ht.array(nx, split=0)
+        np.testing.assert_array_equal(x.numpy(), nx)
+        np.testing.assert_array_equal(np.asarray(x), nx)
+        self.assertEqual(x.tolist(), nx.tolist())
+
+    def test_repr(self):
+        x = ht.arange(5, split=0)
+        s = repr(x)
+        self.assertIn("DNDarray", s)
+        self.assertIn("split=0", s)
+        big = ht.zeros((2000,), split=0)
+        s = repr(big)
+        self.assertIn("...", s)
+
+    def test_redistribute_rejects_ragged(self):
+        x = ht.arange(8, split=0)
+        # the balanced identity map is accepted
+        x.redistribute_(target_map=np.ones((8, 1), dtype=np.int64))
+        ragged = np.zeros((8, 1), dtype=np.int64)
+        ragged[0] = 8
+        with pytest.raises(NotImplementedError):
+            x.redistribute_(target_map=ragged)
+
+    def test_halo_api(self):
+        x = ht.arange(8, split=0)
+        x.get_halo(1)
+        self.assertEqual(x.array_with_halos.shape, (8,))
+        with pytest.raises(TypeError):
+            x.get_halo("a")
+        with pytest.raises(ValueError):
+            x.get_halo(-1)

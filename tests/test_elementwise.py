@@ -1,0 +1,301 @@
+"""Tests for the elementwise operator library (arithmetics, relational,
+logical, rounding, exponential, trigonometrics, complex_math).
+
+Model: reference heat/core/tests/test_{arithmetics,relational,logical,
+rounding,exponential,trigonometrics}.py — numpy oracle, all split axes.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from harness import TestCase
+
+
+class TestArithmetics(TestCase):
+    def test_binary_ops_oracle(self):
+        shape = (7, 5)
+        rng = np.random.default_rng(0)
+        a = rng.random(shape).astype(np.float32) + 0.5
+        b = rng.random(shape).astype(np.float32) + 0.5
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            y = ht.array(b, split=split)
+            np.testing.assert_allclose((x + y).numpy(), a + b, rtol=1e-6)
+            np.testing.assert_allclose((x - y).numpy(), a - b, rtol=1e-6)
+            np.testing.assert_allclose((x * y).numpy(), a * b, rtol=1e-6)
+            np.testing.assert_allclose((x / y).numpy(), a / b, rtol=1e-6)
+            np.testing.assert_allclose((x ** y).numpy(), a ** b, rtol=1e-5)
+            np.testing.assert_allclose((x // y).numpy(), a // b, rtol=1e-6)
+            np.testing.assert_allclose(ht.mod(x, y).numpy(), np.mod(a, b), rtol=1e-5, atol=1e-6)
+            self.assertEqual((x + y).split, split)
+
+    def test_mixed_split_operands(self):
+        a = np.arange(12.0, dtype=np.float32).reshape(4, 3)
+        x0 = ht.array(a, split=0)
+        x1 = ht.array(a, split=1)
+        xn = ht.array(a, split=None)
+        # split dominance: left operand's split wins (reference _operations.py:151-172)
+        self.assertEqual((x0 + x1).split, 0)
+        self.assertEqual((xn + x1).split, 1)
+        np.testing.assert_allclose((x0 + x1).numpy(), a + a)
+        np.testing.assert_allclose((xn * x0).numpy(), a * a)
+
+    def test_scalars_and_broadcast(self):
+        a = np.arange(12.0, dtype=np.float32).reshape(4, 3)
+        row = np.arange(3.0, dtype=np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            np.testing.assert_allclose((x + 2).numpy(), a + 2)
+            np.testing.assert_allclose((2 + x).numpy(), a + 2)
+            np.testing.assert_allclose((x * 0.5).numpy(), a * 0.5)
+            np.testing.assert_allclose((1.0 / (x + 1)).numpy(), 1.0 / (a + 1), rtol=1e-6)
+            np.testing.assert_allclose((x + ht.array(row)).numpy(), a + row)
+        # dtype of scalar ops keeps float32 (weak scalar rule)
+        self.assertIs((ht.ones(3, dtype=ht.float32) + 1.0).dtype, ht.float32)
+        self.assertIs((ht.ones(3, dtype=ht.int32) + 1).dtype, ht.int32)
+        self.assertIs((ht.ones(3, dtype=ht.int32) + 1.5).dtype, ht.float32)
+        with pytest.raises(ValueError):
+            ht.add(ht.ones((3, 4)), ht.ones((3, 5)))
+        with pytest.raises(TypeError):
+            ht.add("a", "b")
+
+    def test_int_ops(self):
+        a = np.arange(1, 13, dtype=np.int32).reshape(4, 3)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            np.testing.assert_array_equal((x & 3).numpy(), a & 3)
+            np.testing.assert_array_equal((x | 4).numpy(), a | 4)
+            np.testing.assert_array_equal((x ^ 2).numpy(), a ^ 2)
+            np.testing.assert_array_equal((~x).numpy(), ~a)
+            np.testing.assert_array_equal((x << 1).numpy(), a << 1)
+            np.testing.assert_array_equal((x >> 1).numpy(), a >> 1)
+            np.testing.assert_array_equal(ht.gcd(x, 6).numpy(), np.gcd(a, 6))
+            np.testing.assert_array_equal(ht.lcm(x, 4).numpy(), np.lcm(a, 4))
+        with pytest.raises(TypeError):
+            ht.bitwise_and(ht.ones(3, dtype=ht.float32), 1)
+        with pytest.raises(TypeError):
+            ht.left_shift(ht.ones(3, dtype=ht.float32), 1)
+
+    def test_unary(self):
+        self.assert_func_equal((5, 4), ht.neg, lambda x: -x)
+        self.assert_func_equal((5, 4), ht.pos, lambda x: +x)
+
+    def test_reductions(self):
+        rng = np.random.default_rng(1)
+        a = rng.random((6, 4, 5)).astype(np.float32)
+        for split in (None, 0, 1, 2):
+            x = ht.array(a, split=split)
+            for axis in (None, 0, 1, 2, (0, 1), (0, 2)):
+                np.testing.assert_allclose(
+                    ht.sum(x, axis=axis).numpy(), a.sum(axis=axis), rtol=1e-4
+                )
+            np.testing.assert_allclose(
+                ht.prod(x + 1.0, axis=1).numpy(), (a + 1).prod(axis=1), rtol=1e-4
+            )
+            np.testing.assert_allclose(
+                ht.sum(x, axis=0, keepdims=True).numpy(), a.sum(axis=0, keepdims=True), rtol=1e-4
+            )
+        # split bookkeeping
+        x = ht.array(a, split=1)
+        self.assertEqual(ht.sum(x, axis=0).split, 0)
+        self.assertEqual(ht.sum(x, axis=1).split, None)
+        self.assertEqual(ht.sum(x, axis=2).split, 1)
+        self.assertEqual(ht.sum(x).split, None)
+
+    def test_nan_reductions(self):
+        a = np.array([[1.0, np.nan, 2.0], [np.nan, 3.0, 4.0]], dtype=np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            np.testing.assert_allclose(ht.nansum(x).numpy(), np.nansum(a))
+            np.testing.assert_allclose(ht.nanprod(x, axis=0).numpy(), np.nanprod(a, axis=0))
+            np.testing.assert_allclose(
+                ht.nan_to_num(x).numpy(), np.nan_to_num(a)
+            )
+
+    def test_cumops(self):
+        rng = np.random.default_rng(2)
+        a = rng.random((8, 5)).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            for axis in (0, 1):
+                np.testing.assert_allclose(
+                    ht.cumsum(x, axis).numpy(), np.cumsum(a, axis), rtol=1e-5
+                )
+                np.testing.assert_allclose(
+                    ht.cumprod(x + 1.0, axis).numpy(), np.cumprod(a + 1, axis), rtol=1e-4
+                )
+            self.assertEqual(ht.cumsum(x, 0).split, split)
+        with pytest.raises(TypeError):
+            ht.cumsum(ht.ones((3, 3)), None)
+
+    def test_diff(self):
+        a = np.array([1.0, 3.0, 6.0, 10.0], dtype=np.float32)
+        x = ht.array(a, split=0)
+        np.testing.assert_allclose(ht.diff(x).numpy(), np.diff(a))
+        np.testing.assert_allclose(ht.diff(x, n=2).numpy(), np.diff(a, n=2))
+        b = np.arange(24.0, dtype=np.float32).reshape(4, 6) ** 2
+        for split in (None, 0, 1):
+            y = ht.array(b, split=split)
+            np.testing.assert_allclose(ht.diff(y, axis=0).numpy(), np.diff(b, axis=0))
+            np.testing.assert_allclose(ht.diff(y, axis=1).numpy(), np.diff(b, axis=1))
+        with pytest.raises(ValueError):
+            ht.diff(x, n=-1)
+
+    def test_divmod_copysign_hypot(self):
+        a = np.array([5.0, -7.0, 9.5], dtype=np.float32)
+        b = np.array([2.0, 3.0, -4.0], dtype=np.float32)
+        x, y = ht.array(a), ht.array(b)
+        q, r = ht.divmod(x, y)
+        eq, er = np.divmod(a, b)
+        np.testing.assert_allclose(q.numpy(), eq)
+        np.testing.assert_allclose(r.numpy(), er, atol=1e-6)
+        np.testing.assert_allclose(ht.copysign(x, y).numpy(), np.copysign(a, b))
+        np.testing.assert_allclose(ht.hypot(x, y).numpy(), np.hypot(a, b), rtol=1e-6)
+        np.testing.assert_allclose(ht.fmod(x, y).numpy(), np.fmod(a, b), atol=1e-6)
+        with pytest.raises(TypeError):
+            ht.hypot(ht.ones(3, dtype=ht.int32), ht.ones(3, dtype=ht.int32))
+
+
+class TestRelationalLogical(TestCase):
+    def test_comparisons(self):
+        a = np.array([[1.0, 2.0], [3.0, 4.0]], dtype=np.float32)
+        b = np.array([[2.0, 2.0], [2.0, 2.0]], dtype=np.float32)
+        for split in (None, 0, 1):
+            x, y = ht.array(a, split=split), ht.array(b, split=split)
+            np.testing.assert_array_equal((x == y).numpy(), a == b)
+            np.testing.assert_array_equal((x != y).numpy(), a != b)
+            np.testing.assert_array_equal((x < y).numpy(), a < b)
+            np.testing.assert_array_equal((x <= y).numpy(), a <= b)
+            np.testing.assert_array_equal((x > y).numpy(), a > b)
+            np.testing.assert_array_equal((x >= y).numpy(), a >= b)
+            self.assertIs((x == y).dtype, ht.bool)
+        self.assertTrue(ht.equal(ht.array(a), ht.array(a)))
+        self.assertFalse(ht.equal(ht.array(a), ht.array(b)))
+        self.assertFalse(ht.equal(ht.array(a), ht.ones((3, 3))))
+
+    def test_all_any(self):
+        a = np.array([[True, True, False], [True, True, True]])
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            self.assertFalse(bool(ht.all(x)))
+            self.assertTrue(bool(ht.any(x)))
+            np.testing.assert_array_equal(ht.all(x, axis=0).numpy(), a.all(axis=0))
+            np.testing.assert_array_equal(ht.any(x, axis=1).numpy(), a.any(axis=1))
+
+    def test_close(self):
+        a = np.array([1.0, 2.0], dtype=np.float32)
+        x = ht.array(a)
+        self.assertTrue(ht.allclose(x, x + 1e-8))
+        self.assertFalse(ht.allclose(x, x + 1.0))
+        np.testing.assert_array_equal(
+            ht.isclose(x, x + 1e-8).numpy(), np.isclose(a, a + 1e-8)
+        )
+
+    def test_is_tests(self):
+        a = np.array([1.0, np.nan, np.inf, -np.inf], dtype=np.float32)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            np.testing.assert_array_equal(ht.isnan(x).numpy(), np.isnan(a))
+            np.testing.assert_array_equal(ht.isinf(x).numpy(), np.isinf(a))
+            np.testing.assert_array_equal(ht.isfinite(x).numpy(), np.isfinite(a))
+            np.testing.assert_array_equal(ht.isposinf(x).numpy(), np.isposinf(a))
+            np.testing.assert_array_equal(ht.isneginf(x).numpy(), np.isneginf(a))
+            np.testing.assert_array_equal(ht.signbit(x).numpy(), np.signbit(a))
+
+    def test_logical(self):
+        a = np.array([True, False, True])
+        b = np.array([True, True, False])
+        x, y = ht.array(a), ht.array(b)
+        np.testing.assert_array_equal(ht.logical_and(x, y).numpy(), a & b)
+        np.testing.assert_array_equal(ht.logical_or(x, y).numpy(), a | b)
+        np.testing.assert_array_equal(ht.logical_xor(x, y).numpy(), a ^ b)
+        np.testing.assert_array_equal(ht.logical_not(x).numpy(), ~a)
+
+
+class TestRounding(TestCase):
+    def test_rounding(self):
+        a = np.array([-1.7, -0.5, 0.0, 0.5, 1.7], dtype=np.float32)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            np.testing.assert_allclose(ht.abs(x).numpy(), np.abs(a))
+            np.testing.assert_allclose(ht.fabs(x).numpy(), np.fabs(a))
+            np.testing.assert_allclose(ht.ceil(x).numpy(), np.ceil(a))
+            np.testing.assert_allclose(ht.floor(x).numpy(), np.floor(a))
+            np.testing.assert_allclose(ht.trunc(x).numpy(), np.trunc(a))
+            np.testing.assert_allclose(ht.round(x).numpy(), np.round(a))
+            np.testing.assert_allclose(ht.sign(x).numpy(), np.sign(a))
+            np.testing.assert_allclose(
+                ht.clip(x, -1.0, 1.0).numpy(), np.clip(a, -1, 1)
+            )
+        frac, whole = ht.modf(ht.array(a))
+        efrac, ewhole = np.modf(a)
+        np.testing.assert_allclose(frac.numpy(), efrac, atol=1e-6)
+        np.testing.assert_allclose(whole.numpy(), ewhole)
+        self.assertEqual(int(ht.abs(ht.array([-3])).numpy()[0]), 3)
+        with pytest.raises(ValueError):
+            ht.clip(ht.array(a))
+
+
+class TestExponentialTrig(TestCase):
+    def test_exponential(self):
+        a = np.array([0.5, 1.0, 2.0], dtype=np.float32)
+        x = ht.array(a, split=0)
+        np.testing.assert_allclose(ht.exp(x).numpy(), np.exp(a), rtol=1e-6)
+        np.testing.assert_allclose(ht.exp2(x).numpy(), np.exp2(a), rtol=1e-6)
+        np.testing.assert_allclose(ht.expm1(x).numpy(), np.expm1(a), rtol=1e-6)
+        np.testing.assert_allclose(ht.log(x).numpy(), np.log(a), rtol=1e-6)
+        np.testing.assert_allclose(ht.log2(x).numpy(), np.log2(a), rtol=1e-6)
+        np.testing.assert_allclose(ht.log10(x).numpy(), np.log10(a), rtol=1e-6)
+        np.testing.assert_allclose(ht.log1p(x).numpy(), np.log1p(a), rtol=1e-6)
+        np.testing.assert_allclose(ht.sqrt(x).numpy(), np.sqrt(a), rtol=1e-6)
+        np.testing.assert_allclose(ht.square(x).numpy(), np.square(a), rtol=1e-6)
+        y = ht.array(a)
+        np.testing.assert_allclose(ht.logaddexp(x, y).numpy(), np.logaddexp(a, a), rtol=1e-6)
+        np.testing.assert_allclose(ht.logaddexp2(x, y).numpy(), np.logaddexp2(a, a), rtol=1e-6)
+        # int input promotes to float (reference _operations.py local op cast)
+        self.assertIs(ht.exp(ht.arange(3)).dtype, ht.float32)
+
+    def test_trig(self):
+        a = np.array([-0.9, -0.5, 0.0, 0.5, 0.9], dtype=np.float32)
+        x = ht.array(a, split=0)
+        for ht_fn, np_fn in [
+            (ht.sin, np.sin),
+            (ht.cos, np.cos),
+            (ht.tan, np.tan),
+            (ht.arcsin, np.arcsin),
+            (ht.arccos, np.arccos),
+            (ht.arctan, np.arctan),
+            (ht.sinh, np.sinh),
+            (ht.cosh, np.cosh),
+            (ht.tanh, np.tanh),
+            (ht.arcsinh, np.arcsinh),
+            (ht.arctanh, np.arctanh),
+            (ht.deg2rad, np.deg2rad),
+            (ht.rad2deg, np.rad2deg),
+        ]:
+            np.testing.assert_allclose(ht_fn(x).numpy(), np_fn(a), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            ht.arccosh(ht.array([1.5, 2.0])).numpy(), np.arccosh([1.5, 2.0]), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            ht.arctan2(x, ht.array(a[::-1].copy())).numpy(), np.arctan2(a, a[::-1]), rtol=1e-5
+        )
+        self.assertIs(ht.arctan2(ht.arange(3), ht.arange(3)).dtype, ht.float32)
+
+
+class TestComplex(TestCase):
+    def test_complex(self):
+        a = np.array([1 + 2j, 3 - 4j], dtype=np.complex64)
+        x = ht.array(a)
+        np.testing.assert_allclose(ht.real(x).numpy(), a.real)
+        np.testing.assert_allclose(ht.imag(x).numpy(), a.imag)
+        np.testing.assert_allclose(ht.conj(x).numpy(), np.conj(a))
+        np.testing.assert_allclose(ht.angle(x).numpy(), np.angle(a), rtol=1e-6)
+        np.testing.assert_allclose(ht.angle(x, deg=True).numpy(), np.angle(a, deg=True), rtol=1e-6)
+        r = ht.array([1.0, 2.0])
+        np.testing.assert_allclose(ht.real(r).numpy(), [1.0, 2.0])
+        np.testing.assert_allclose(ht.imag(r).numpy(), [0.0, 0.0])
+        np.testing.assert_array_equal(ht.iscomplex(x).numpy(), np.iscomplex(a))
+        np.testing.assert_array_equal(ht.isreal(x).numpy(), np.isreal(a))

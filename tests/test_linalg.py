@@ -1,0 +1,206 @@
+"""Tests for linalg (reference model: heat/core/linalg/tests/test_basics.py,
+test_qr.py, test_solver.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from harness import TestCase
+
+
+class TestMatmul(TestCase):
+    def test_matmul_splits(self):
+        rng = np.random.default_rng(0)
+        a = rng.random((16, 12)).astype(np.float32)
+        b = rng.random((12, 8)).astype(np.float32)
+        expected = a @ b
+        for sa in (None, 0, 1):
+            for sb in (None, 0, 1):
+                x = ht.array(a, split=sa)
+                y = ht.array(b, split=sb)
+                z = ht.matmul(x, y)
+                np.testing.assert_allclose(z.numpy(), expected, rtol=1e-4)
+                z2 = x @ y
+                np.testing.assert_allclose(z2.numpy(), expected, rtol=1e-4)
+        # split bookkeeping: row-split left -> row-split out; col-split right -> col-split out
+        self.assertEqual(ht.matmul(ht.array(a, split=0), ht.array(b)).split, 0)
+        self.assertEqual(ht.matmul(ht.array(a), ht.array(b, split=1)).split, 1)
+        self.assertEqual(ht.matmul(ht.array(a, split=1), ht.array(b, split=0)).split, None)
+
+    def test_matmul_vector_cases(self):
+        rng = np.random.default_rng(1)
+        a = rng.random((8, 5)).astype(np.float32)
+        v = rng.random(5).astype(np.float32)
+        np.testing.assert_allclose(
+            ht.matmul(ht.array(a, split=0), ht.array(v)).numpy(), a @ v, rtol=1e-5
+        )
+
+    def test_dot(self):
+        a = np.arange(8.0, dtype=np.float32)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            self.assertAlmostEqual(float(ht.dot(x, x)), float(a @ a), places=3)
+        m = np.arange(12.0, dtype=np.float32).reshape(3, 4)
+        np.testing.assert_allclose(
+            ht.dot(ht.array(m, split=0), ht.array(m.T.copy(), split=1)).numpy(), m @ m.T, rtol=1e-5
+        )
+
+    def test_vdot_vecdot(self):
+        a = np.array([1 + 2j, 3 + 4j], dtype=np.complex64)
+        b = np.array([5 + 6j, 7 + 8j], dtype=np.complex64)
+        self.assertAlmostEqual(complex(ht.vdot(ht.array(a), ht.array(b)).item()), np.vdot(a, b), places=4)
+        x = np.arange(12.0, dtype=np.float32).reshape(4, 3)
+        for split in (None, 0, 1):
+            r = ht.vecdot(ht.array(x, split=split), ht.array(x, split=split))
+            np.testing.assert_allclose(r.numpy(), (x * x).sum(-1), rtol=1e-5)
+
+    def test_outer(self):
+        a = np.arange(4.0, dtype=np.float32)
+        b = np.arange(5.0, dtype=np.float32)
+        for split in (None, 0):
+            r = ht.outer(ht.array(a, split=split), ht.array(b, split=split))
+            np.testing.assert_allclose(r.numpy(), np.outer(a, b))
+        self.assertEqual(ht.outer(ht.array(a, split=0), ht.array(b)).split, 0)
+
+    def test_projection_cross(self):
+        a = np.array([1.0, 2.0, 3.0], dtype=np.float32)
+        b = np.array([0.0, 1.0, 0.0], dtype=np.float32)
+        np.testing.assert_allclose(
+            ht.projection(ht.array(a), ht.array(b)).numpy(), np.array([0.0, 2.0, 0.0])
+        )
+        np.testing.assert_allclose(
+            ht.cross(ht.array(a), ht.array(b)).numpy(), np.cross(a, b)
+        )
+        with pytest.raises(RuntimeError):
+            ht.projection(ht.array(np.ones((2, 2), np.float32)), ht.array(b))
+
+
+class TestStructure(TestCase):
+    def test_transpose(self):
+        a = np.arange(24.0, dtype=np.float32).reshape(2, 3, 4)
+        for split in (None, 0, 1, 2):
+            x = ht.array(a, split=split)
+            np.testing.assert_array_equal(x.T.numpy(), a.T)
+            np.testing.assert_array_equal(
+                ht.transpose(x, (1, 0, 2)).numpy(), np.transpose(a, (1, 0, 2))
+            )
+        x = ht.array(a, split=1)
+        self.assertEqual(ht.transpose(x, (1, 0, 2)).split, 0)
+        self.assertEqual(x.T.split, 1)
+        with pytest.raises(ValueError):
+            ht.transpose(x, (0, 1))
+
+    def test_tril_triu(self):
+        a = np.arange(16.0, dtype=np.float32).reshape(4, 4)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            np.testing.assert_array_equal(ht.tril(x).numpy(), np.tril(a))
+            np.testing.assert_array_equal(ht.triu(x).numpy(), np.triu(a))
+            np.testing.assert_array_equal(ht.tril(x, k=1).numpy(), np.tril(a, k=1))
+            np.testing.assert_array_equal(ht.triu(x, k=-1).numpy(), np.triu(a, k=-1))
+
+    def test_trace(self):
+        a = np.arange(16.0, dtype=np.float32).reshape(4, 4)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            self.assertAlmostEqual(float(ht.trace(x)), np.trace(a))
+        with pytest.raises(ValueError):
+            ht.trace(ht.arange(3))
+
+    def test_norms(self):
+        a = np.array([[1.0, 2.0], [3.0, 4.0]], dtype=np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            self.assertAlmostEqual(float(ht.norm(x)), np.linalg.norm(a), places=4)
+            self.assertAlmostEqual(
+                float(ht.matrix_norm(x, ord=1)), np.linalg.norm(a, ord=1), places=4
+            )
+            self.assertAlmostEqual(
+                float(ht.matrix_norm(x, ord=np.inf)), np.linalg.norm(a, ord=np.inf), places=4
+            )
+        v = np.array([3.0, 4.0], dtype=np.float32)
+        self.assertAlmostEqual(float(ht.vector_norm(ht.array(v))), 5.0, places=5)
+        self.assertAlmostEqual(
+            float(ht.vector_norm(ht.array(v), ord=1)), 7.0, places=5
+        )
+
+    def test_det_inv(self):
+        a = np.array([[4.0, 2.0], [1.0, 3.0]], dtype=np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            self.assertAlmostEqual(float(ht.det(x)), np.linalg.det(a), places=3)
+            np.testing.assert_allclose(ht.inv(x).numpy(), np.linalg.inv(a), rtol=1e-4)
+        with pytest.raises(ValueError):
+            ht.det(ht.ones((2, 3)))
+        with pytest.raises(ValueError):
+            ht.inv(ht.ones((2, 3)))
+
+
+class TestQR(TestCase):
+    def _check_qr(self, a_np, split):
+        x = ht.array(a_np, split=split)
+        q, r = ht.linalg.qr(x)
+        m, n = a_np.shape
+        k = min(m, n)
+        self.assertEqual(q.shape, (m, k))
+        self.assertEqual(r.shape, (k, n))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a_np, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            q.numpy().T @ q.numpy(), np.eye(k, dtype=a_np.dtype), atol=1e-4
+        )
+        # R upper triangular
+        np.testing.assert_allclose(np.tril(r.numpy(), -1), np.zeros_like(r.numpy()), atol=1e-5)
+
+    def test_qr_tall_skinny_tsqr(self):
+        rng = np.random.default_rng(3)
+        # 64 rows over 8 devices, 8/p = 8 >= n = 4 -> TSQR path
+        a = rng.random((64, 4)).astype(np.float32)
+        self._check_qr(a, split=0)
+
+    def test_qr_replicated_and_split1(self):
+        rng = np.random.default_rng(4)
+        a = rng.random((12, 12)).astype(np.float32)
+        self._check_qr(a, None)
+        self._check_qr(a, 1)
+        # short-wide, split 0 falls back to the gathered kernel
+        b = rng.random((6, 10)).astype(np.float32)
+        self._check_qr(b, 0)
+        q, r = ht.linalg.qr(ht.array(a, split=0), calc_q=False)
+        self.assertIsNone(q)
+        np.testing.assert_allclose(np.tril(r.numpy(), -1), 0, atol=1e-5)
+        with pytest.raises(ValueError):
+            ht.linalg.qr(ht.arange(4))
+
+
+class TestSolver(TestCase):
+    def test_cg(self):
+        rng = np.random.default_rng(5)
+        b = rng.random((10, 10)).astype(np.float32)
+        spd = b @ b.T + 10 * np.eye(10, dtype=np.float32)
+        rhs = rng.random(10).astype(np.float32)
+        expected = np.linalg.solve(spd, rhs)
+        for split in (None, 0):
+            A = ht.array(spd, split=split)
+            x0 = ht.zeros(10, split=None if split is None else 0)
+            x = ht.linalg.cg(A, ht.array(rhs), x0)
+            np.testing.assert_allclose(x.numpy(), expected, rtol=1e-2, atol=1e-3)
+        with pytest.raises(TypeError):
+            ht.linalg.cg(spd, rhs, None)
+        with pytest.raises(RuntimeError):
+            ht.linalg.cg(ht.arange(4), ht.arange(4), ht.arange(4))
+
+    def test_lanczos(self):
+        rng = np.random.default_rng(6)
+        b = rng.random((12, 12)).astype(np.float32)
+        A = (b + b.T) / 2
+        for split in (None, 0):
+            x = ht.array(A, split=split)
+            V, T = ht.linalg.lanczos(x, 12)
+            # V tridiagonalizes A: V^T A V == T
+            VtAV = V.numpy().T @ A @ V.numpy()
+            np.testing.assert_allclose(VtAV, T.numpy(), atol=1e-2)
+        with pytest.raises(TypeError):
+            ht.linalg.lanczos(A, 4)
+        with pytest.raises(RuntimeError):
+            ht.linalg.lanczos(ht.arange(4), 2)

@@ -1,0 +1,96 @@
+"""Benchmark entry point (driver contract: prints ONE JSON line).
+
+Tracked config 3 of BASELINE.md: kmeans, k=8 on 10M×16 float32, split=0.
+The metric is Lloyd iterations/second on the available chip(s); vs_baseline
+is the speedup over a torch-CPU implementation of the same iteration measured
+on the same machine (the reference's single-node comparison baseline,
+reference benchmarks/kmeans/{heat,torch}-cpu.py — no absolute numbers are
+published in the reference repo, see BASELINE.md).
+"""
+
+import json
+import time
+
+import numpy as np
+
+N, F, K = 10_000_000, 16, 8
+ITERS = 10
+
+
+def bench_heat_tpu() -> float:
+    import jax
+
+    import heat_tpu as ht
+    from heat_tpu.cluster.kmeans import _lloyd_run
+
+    comm = ht.get_comm()
+    n = (N // comm.size) * comm.size
+    rng = np.random.default_rng(0)
+    centers0 = rng.standard_normal((K, F)).astype(np.float32) * 3
+    # generate data on device to skip a 640MB host transfer
+    import jax.numpy as jnp
+
+    data = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(1), (n, F), dtype=jnp.float32),
+        comm.sharding(2, 0),
+    )
+    centers = jnp.asarray(centers0)
+    # warmup/compile (fused ITERS-step program, one dispatch); synchronize via
+    # a scalar host read — block_until_ready is unreliable on the axon backend
+    c, lab, inertia, shift = _lloyd_run(data, centers, K, ITERS)
+    float(shift)
+    best = float("inf")
+    for _ in range(3):
+        start = time.perf_counter()
+        centers2, lab, inertia, shift = _lloyd_run(data, centers, K, ITERS)
+        float(shift)
+        best = min(best, time.perf_counter() - start)
+    return ITERS / best
+
+
+def bench_torch_cpu(iters: int = 2) -> float:
+    import torch
+
+    torch.manual_seed(1)
+    scale = 10  # run the torch baseline on N/scale points, rate scales linearly
+    n = N // scale
+    data = torch.randn(n, F)
+    centers = torch.randn(K, F) * 3
+
+    def step(data, centers):
+        d2 = torch.cdist(data, centers) ** 2
+        labels = d2.argmin(dim=1)
+        onehot = torch.nn.functional.one_hot(labels, K).to(data.dtype)
+        counts = onehot.sum(0)
+        sums = onehot.T @ data
+        return torch.where(counts[:, None] > 0, sums / counts.clamp(min=1.0)[:, None], centers)
+
+    step(data, centers)  # warmup
+    start = time.perf_counter()
+    for _ in range(iters):
+        centers = step(data, centers)
+    elapsed = time.perf_counter() - start
+    return iters / elapsed / scale  # iters/sec at full N
+
+
+def main():
+    ours = bench_heat_tpu()
+    try:
+        baseline = bench_torch_cpu()
+        vs = ours / baseline if baseline > 0 else float("nan")
+    except Exception:
+        vs = float("nan")
+    print(
+        json.dumps(
+            {
+                "metric": "kmeans_iters_per_sec_10Mx16_k8",
+                "value": round(ours, 3),
+                "unit": "iters/s",
+                "vs_baseline": round(vs, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

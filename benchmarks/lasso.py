@@ -1,0 +1,55 @@
+"""Lasso benchmark (reference: benchmarks/lasso/config.json protocol)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import json
+import time
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--n", type=int, default=100_000)
+    parser.add_argument("--f", type=int, default=64)
+    parser.add_argument("--iterations", type=int, default=20)
+    parser.add_argument("--trials", type=int, default=3)
+    args = parser.parse_args()
+
+    import os
+
+    if os.environ.get("HEAT_TPU_FORCE_CPU") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import heat_tpu as ht
+
+    ht.random.seed(0)
+    x = ht.random.randn(args.n, args.f, split=0)
+    y = ht.random.randn(args.n, split=0)
+
+    times = []
+    for _ in range(args.trials):
+        lasso = ht.regression.Lasso(lam=0.1, max_iter=args.iterations, tol=None)
+        start = time.perf_counter()
+        lasso.fit(x, y)
+        float(lasso.theta.larray[0, 0])
+        times.append(time.perf_counter() - start)
+    print(
+        json.dumps(
+            {
+                "benchmark": "lasso",
+                "n": args.n,
+                "f": args.f,
+                "devices": ht.get_comm().size,
+                "time_s": round(min(times), 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Weak-scaling harness (reference: benchmarks/generate_jobscripts.py:12-50
+generates SLURM jobs over 1..15 nodes; on TPU the mesh is virtualized instead:
+the same benchmark runs at mesh sizes 1/2/4/8 with problem size scaled
+per-device, reporting parallel efficiency).
+
+Run on CPU with forced host devices to validate scaling behavior:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 python benchmarks/run_weak_scaling.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import json
+import subprocess
+import sys
+
+
+BENCHMARKS = {
+    "kmeans": lambda per_dev, p: ["--n", str(per_dev * p), "--iterations", "10", "--trials", "2"],
+    "distance_matrix": lambda per_dev, p: ["--n", str(per_dev * p), "--trials", "2"],
+    "statistical_moments": lambda per_dev, p: ["--rows", str(per_dev * p), "--trials", "3"],
+}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--benchmark", choices=BENCHMARKS, default="kmeans")
+    parser.add_argument("--per-device", type=int, default=125_000)
+    parser.add_argument("--sizes", type=int, nargs="+", default=[1, 2, 4, 8])
+    args = parser.parse_args()
+
+    results = []
+    for p in args.sizes:
+        import os
+
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={p}"
+        env["HEAT_TPU_FORCE_CPU"] = "1"
+        out = subprocess.run(
+            [sys.executable, f"benchmarks/{args.benchmark}.py"]
+            + BENCHMARKS[args.benchmark](args.per_device, p),
+            capture_output=True,
+            text=True,
+            env=env,
+        )
+        line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else "{}"
+        try:
+            results.append(json.loads(line))
+        except json.JSONDecodeError:
+            print(out.stdout, out.stderr, file=sys.stderr)
+            raise
+        print(line)
+
+    if len(results) > 1 and "time_s" in results[0]:
+        eff = results[0]["time_s"] / results[-1]["time_s"]
+        print(json.dumps({"weak_scaling_efficiency": round(eff, 3), "sizes": args.sizes}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Clustering demo (reference: examples/ cluster demo on the iris dataset).
+
+Runs KMeans / KMedians / KMedoids / Spectral on the bundled iris-like dataset
+and prints label distributions.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import heat_tpu as ht
+
+
+def main():
+    x, y = ht.datasets.iris_like(split=0, return_labels=True)
+    print(f"dataset: {x.shape} on {ht.get_comm().size} device(s)")
+    for name, est in [
+        ("KMeans", ht.cluster.KMeans(n_clusters=3, init="kmeans++", random_state=0)),
+        ("KMedians", ht.cluster.KMedians(n_clusters=3, init="kmeans++", random_state=0)),
+        ("KMedoids", ht.cluster.KMedoids(n_clusters=3, init="kmeans++", random_state=0)),
+        ("Spectral", ht.cluster.Spectral(n_clusters=3, gamma=0.5, n_lanczos=50, random_state=0)),
+    ]:
+        est.fit(x)
+        labels = est.labels_.numpy()
+        counts = np.bincount(labels, minlength=3)
+        print(f"{name:10s} cluster sizes: {counts.tolist()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""DASO hierarchical training demo (reference: the DASO usage pattern in
+heat/optim/dp_optimizer.py's docstring / examples).
+
+Trains a ResNet on synthetic CIFAR-shaped data over a (dcn x ici) mesh with
+the skip-scheduled global synchronization."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import heat_tpu as ht
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n, classes = 512, 10
+    X = rng.standard_normal((n, 32, 32, 3)).astype(np.float32)
+    y = rng.integers(0, classes, n).astype(np.int32)
+
+    epochs = 6
+    daso = ht.optim.DASO(
+        local_optimizer=ht.optim.SGD(0.05),
+        total_epochs=epochs,
+        warmup_epochs=1,
+        cooldown_epochs=1,
+        verbose=True,
+    )
+    print(f"topology: {daso.nodes} DCN group(s) x {daso.ici_size} ICI device(s)")
+    model = ht.nn.ResNet(stage_sizes=(1, 1), num_classes=classes, num_filters=16)
+    daso.add_model(model, 0, X[:4])
+
+    batch = 64
+    for epoch in range(epochs):
+        losses = []
+        for b in range(0, n, batch):
+            losses.append(daso.step(X[b : b + batch], y[b : b + batch]))
+        epoch_loss = float(np.mean(losses))
+        daso.epoch_loss_logic(epoch_loss)
+        print(f"epoch {epoch}: loss {epoch_loss:.4f} (global_skips={daso.global_skip})")
+
+
+if __name__ == "__main__":
+    main()

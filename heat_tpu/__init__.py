@@ -7,7 +7,8 @@ collectives the reference hand-codes over MPI. See SURVEY.md for the blueprint.
 """
 
 from .core import *
-from .core import linalg
+from .core import linalg, random
+from . import classification, cluster, datasets, graph, naive_bayes, nn, optim, regression, spatial, utils
 from .core import (
     arithmetics,
     base,
@@ -17,18 +18,66 @@ from .core import (
     devices,
     exponential,
     factories,
+    indexing,
     logical,
+    manipulations,
     memory,
     printing,
     relational,
     rounding,
     sanitation,
+    signal,
+    statistics,
     stride_tricks,
     trigonometrics,
     types,
     version,
 )
 from .core.version import __version__
+
+
+def _bind_dndarray_methods():
+    """Bind the operator library onto DNDarray as methods — the reference
+    exposes most library functions as both ``ht.fn(x)`` and ``x.fn()``
+    (reference dndarray.py method defs scattered through the modules)."""
+    from .core.dndarray import DNDarray as _D
+
+    _method_sources = {
+        arithmetics: [
+            "add", "sub", "mul", "div", "pow", "fmod", "mod", "cumsum", "cumprod",
+            "prod", "sum", "nansum", "nanprod", "diff",
+        ],
+        rounding: ["abs", "ceil", "clip", "fabs", "floor", "modf", "round", "trunc", "sign", "sgn"],
+        exponential: ["exp", "expm1", "exp2", "log", "log2", "log10", "log1p", "sqrt", "square"],
+        trigonometrics: [
+            "sin", "cos", "tan", "sinh", "cosh", "tanh", "arcsin", "arccos", "arctan",
+            "arcsinh", "arccosh", "arctanh",
+        ],
+        logical: ["all", "any", "allclose", "isclose"],
+        statistics: [
+            "argmax", "argmin", "average", "max", "mean", "median", "min", "percentile",
+            "std", "var", "kurtosis", "skew",
+        ],
+        manipulations: [
+            "expand_dims", "flatten", "ravel", "reshape", "resplit", "squeeze", "unique",
+            "flip", "roll", "repeat", "tile", "moveaxis", "swapaxes", "collect",
+        ],
+        complex_math: ["conj"],
+        indexing: ["nonzero"],
+    }
+    for module, names in _method_sources.items():
+        for name in names:
+            if not hasattr(_D, name):
+                setattr(_D, name, getattr(module, name))
+    _D.transpose = linalg.transpose
+    _D.tril = linalg.tril
+    _D.triu = linalg.triu
+    _D.dot = linalg.dot
+    _D.qr = linalg.qr
+
+
+_bind_dndarray_methods()
+del _bind_dndarray_methods
 
 
 def __getattr__(name):

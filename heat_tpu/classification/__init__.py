@@ -1,0 +1,3 @@
+"""Distributed classification (reference: heat/classification/__init__.py)."""
+
+from .kneighborsclassifier import *

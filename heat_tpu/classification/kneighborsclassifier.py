@@ -1,0 +1,84 @@
+"""K-nearest-neighbors classifier (reference:
+heat/classification/kneighborsclassifier.py:62-135).
+
+Pipeline identical to the reference: cdist to the training set → topk of the
+negated distances → one-hot vote → argmax. All three stages are sharded XLA
+ops (the reference's distributed topk merge op is manipulations.topk here).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core import factories, types
+from ..core.base import BaseEstimator, ClassificationMixin
+from ..core.dndarray import DNDarray, _ensure_split
+from ..spatial import distance
+
+__all__ = ["KNeighborsClassifier"]
+
+
+class KNeighborsClassifier(ClassificationMixin, BaseEstimator):
+    """KNN classification (reference kneighborsclassifier.py:14-61).
+
+    Parameters
+    ----------
+    n_neighbors : int
+        Number of neighbors considered in the vote.
+    """
+
+    def __init__(self, n_neighbors: int = 5):
+        self.n_neighbors = n_neighbors
+        self.x = None
+        self.y = None
+        self.classes = None
+
+    def fit(self, x: DNDarray, y: DNDarray) -> "KNeighborsClassifier":
+        """Memorize the training set (reference kneighborsclassifier.py:62-88).
+
+        ``y`` may be integer labels (n,) or one-hot (n, c).
+        """
+        if not isinstance(x, DNDarray) or not isinstance(y, DNDarray):
+            raise TypeError("x and y need to be DNDarrays")
+        if x.shape[0] != y.shape[0]:
+            raise ValueError("Number of samples and labels needs to be the same")
+        self.x = x
+        if y.ndim == 1:
+            import jax.numpy as _jnp
+
+            classes = _jnp.unique(y.larray)
+            self.classes = classes
+            onehot = (y.larray[:, None] == classes[None, :]).astype(_jnp.float32)
+            self.y = onehot
+        elif y.ndim == 2:
+            self.classes = None
+            self.y = y.larray.astype(jnp.float32)
+        else:
+            raise ValueError(f"labels need to be 1D or 2D, but were {y.ndim}D")
+        return self
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """Label prediction (reference kneighborsclassifier.py:89-135)."""
+        if self.x is None:
+            raise RuntimeError("fit needs to be called before predict")
+        if not isinstance(x, DNDarray):
+            raise TypeError("x needs to be a DNDarray")
+        d = distance.cdist(x, self.x, quadratic_expansion=True)  # (m, n)
+        k = self.n_neighbors
+        # indices of the k smallest distances (top-k, not a full sort)
+        import jax
+
+        _, idx = jax.lax.top_k(-d.larray, k)  # (m, k)
+        votes = self.y[idx]  # (m, k, c)
+        counts = jnp.sum(votes, axis=1)  # (m, c)
+        winner = jnp.argmax(counts, axis=1)  # (m,)
+        if self.classes is not None:
+            labels = self.classes[winner]
+        else:
+            labels = winner.astype(jnp.int32)
+        labels = _ensure_split(labels, x.split, x.comm)
+        return DNDarray(
+            labels, tuple(labels.shape), types.canonical_heat_type(labels.dtype), x.split, x.device, x.comm
+        )

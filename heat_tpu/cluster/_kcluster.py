@@ -1,0 +1,131 @@
+"""Shared k-clustering base (reference: heat/cluster/_kcluster.py).
+
+Centroid initialization follows the reference: ``"random"`` samples k rows
+(the reference Bcasts each owning rank's row, _kcluster.py:100-129 — global
+indexing makes the Bcast implicit), ``"probability_based"`` is kmeans++ with
+cdist-min sampling (:142-187). Assignment is metric + argmin (:196-209),
+compiled as one XLA kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import factories, types
+from ..core import random as ht_random
+from ..core.base import BaseEstimator, ClusteringMixin
+from ..core.dndarray import DNDarray, _ensure_split
+
+__all__ = ["_KCluster"]
+
+
+class _KCluster(ClusteringMixin, BaseEstimator):
+    """Base class for k-statistics clustering (reference _kcluster.py:13-86).
+
+    Parameters
+    ----------
+    metric : callable(x, y) -> distances
+        Pairwise-distance kernel on jax arrays.
+    n_clusters, init, max_iter, tol, random_state : see reference.
+    """
+
+    def __init__(
+        self,
+        metric: Callable,
+        n_clusters: int,
+        init: Union[str, DNDarray],
+        max_iter: int,
+        tol: float,
+        random_state: Optional[int],
+    ):
+        self.n_clusters = n_clusters
+        self.init = init
+        self.max_iter = max_iter
+        self.tol = tol
+        self.random_state = random_state
+
+        self._metric = metric
+        self._cluster_centers = None
+        self._labels = None
+        self._inertia = None
+        self._n_iter = None
+
+        if random_state is not None:
+            ht_random.seed(random_state)
+
+        if isinstance(init, DNDarray):
+            if init.shape[0] != n_clusters:
+                raise ValueError(
+                    f"passed centroids do not match n_clusters: {init.shape[0]} != {n_clusters}"
+                )
+            self.init = "precomputed"
+            self._precomputed = init
+        elif init not in ("random", "probability_based", "kmeans++", "k-means++", "batchparallel"):
+            raise ValueError(f"Initialization method {init!r} not supported")
+
+    @property
+    def cluster_centers_(self) -> DNDarray:
+        return self._cluster_centers
+
+    @property
+    def labels_(self) -> DNDarray:
+        return self._labels
+
+    @property
+    def inertia_(self) -> float:
+        return self._inertia
+
+    @property
+    def n_iter_(self) -> int:
+        return self._n_iter
+
+    # ------------------------------------------------------------------
+    def _initialize_cluster_centers(self, x: DNDarray) -> jax.Array:
+        """Pick initial centroids (reference _kcluster.py:87-195)."""
+        k = self.n_clusters
+        data = x.larray.astype(jnp.promote_types(x.dtype.jax_type(), jnp.float32))
+        n = data.shape[0]
+        if self.init == "precomputed":
+            return self._precomputed.larray.astype(data.dtype)
+        if self.init == "random":
+            idx = ht_random.randint(0, n, (k,)).larray
+            return data[idx]
+        # kmeans++ / probability_based (reference _kcluster.py:142-187)
+        idx0 = int(ht_random.randint(0, n, (1,)).larray[0])
+        centers = data[idx0][None, :]
+        for _ in range(1, k):
+            d = self._metric(data, centers)
+            closest = jnp.min(d, axis=1)
+            prob = closest / jnp.sum(closest)
+            r = float(ht_random.rand(1).larray[0])
+            cum = jnp.cumsum(prob)
+            nxt = int(jnp.searchsorted(cum, r))
+            nxt = min(nxt, n - 1)
+            centers = jnp.concatenate([centers, data[nxt][None, :]], axis=0)
+        return centers
+
+    def _assign_to_cluster(self, x: DNDarray):
+        """Cluster id per sample (reference _kcluster.py:196-209)."""
+        data = x.larray.astype(jnp.promote_types(x.dtype.jax_type(), jnp.float32))
+        d = self._metric(data, self._cluster_centers.larray)
+        labels = jnp.argmin(d, axis=1)
+        return self._wrap_labels(labels, x)
+
+    def _wrap_labels(self, labels: jax.Array, x: DNDarray) -> DNDarray:
+        labels = labels.astype(types.index_dtype())
+        labels = _ensure_split(labels, x.split, x.comm)
+        return DNDarray(
+            labels, tuple(labels.shape), types.canonical_heat_type(labels.dtype), x.split, x.device, x.comm
+        )
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """Nearest-centroid labels for new data (reference _kcluster.py:210-254)."""
+        if self._cluster_centers is None:
+            raise RuntimeError("fit needs to be called before predict")
+        if not isinstance(x, DNDarray):
+            raise ValueError(f"input needs to be a DNDarray, but was {type(x)}")
+        return self._assign_to_cluster(x)

@@ -1,0 +1,124 @@
+"""K-Means clustering (reference: heat/cluster/kmeans.py).
+
+The reference's Lloyd loop (kmeans.py:102-139) computes cdist against
+replicated centroids, argmin-assigns, then per-cluster masked mean updates —
+k Allreduces of (1, f) rows per iteration (kmeans.py:73-100). Here the whole
+iteration is ONE jitted XLA program: the assignment is a quadratic-expansion
+matmul (MXU), the update is a one-hot matmul (``onehotᵀ @ x`` — MXU again),
+and the only collective is the psum GSPMD inserts for the row-sharded sums.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core import types
+from ..core.dndarray import DNDarray, _ensure_split
+from ..spatial.distance import _sq_euclidian_fast as _sq_dist
+from ._kcluster import _KCluster
+
+__all__ = ["KMeans"]
+
+
+@partial(jax.jit, static_argnames=("k", "n_steps"))
+def _lloyd_run(data: jax.Array, centers: jax.Array, k: int, n_steps: int):
+    """``n_steps`` fused Lloyd iterations in ONE XLA program — amortizes the
+    per-dispatch latency (the reference pays an MPI round per iteration; a
+    remote-dispatch TPU pays one RPC per *program*, so fusing the loop is the
+    TPU-side analog of batching the collectives)."""
+
+    def body(i, carry):
+        centers, _, _, _ = carry
+        return _lloyd_iter(data, centers, k)
+
+    out = jax.lax.fori_loop(
+        0, n_steps, body, (centers, jnp.zeros(data.shape[0], jnp.int32), jnp.float32(0), jnp.float32(0))
+    )
+    return out
+
+
+def _lloyd_iter(data: jax.Array, centers: jax.Array, k: int):
+    d2 = _sq_dist(data, centers)  # (n, k)
+    labels = jnp.argmin(d2, axis=1).astype(jnp.int32)
+    onehot = jax.nn.one_hot(labels, k, dtype=data.dtype)  # (n, k)
+    counts = jnp.sum(onehot, axis=0)  # (k,)
+    sums = onehot.T @ data  # (k, f) — MXU; psum over the sharded rows
+    new_centers = jnp.where(
+        counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), centers
+    )
+    # labels are the argmin, so the assigned distance is the row minimum —
+    # a fused reduction instead of a gather (take_along_axis is ~100x slower
+    # than the min on TPU for this shape)
+    inertia = jnp.sum(jnp.min(d2, axis=1))
+    shift = jnp.sum((new_centers - centers) ** 2)
+    return new_centers, labels, inertia, shift
+
+
+_lloyd_step = partial(jax.jit, static_argnames=("k",))(_lloyd_iter)
+"""One Lloyd iteration (data (n, f) row-sharded, centers (k, f) replicated)."""
+
+
+class KMeans(_KCluster):
+    """K-Means with Lloyd's algorithm (reference kmeans.py:14-139).
+
+    Parameters mirror the reference: n_clusters=8, init='random',
+    max_iter=300, tol=1e-4, random_state=None.
+    """
+
+    def __init__(
+        self,
+        n_clusters: int = 8,
+        init: Union[str, DNDarray] = "random",
+        max_iter: int = 300,
+        tol: float = 1e-4,
+        random_state: Optional[int] = None,
+    ):
+        if isinstance(init, str) and init in ("kmeans++", "k-means++"):
+            init = "probability_based"
+        super().__init__(
+            metric=lambda x, y: _sq_dist(x, y),
+            n_clusters=n_clusters,
+            init=init,
+            max_iter=max_iter,
+            tol=tol,
+            random_state=random_state,
+        )
+
+    def fit(self, x: DNDarray) -> "KMeans":
+        """Cluster ``x`` (n_samples, n_features) (reference kmeans.py:102-139)."""
+        if not isinstance(x, DNDarray):
+            raise ValueError(f"input needs to be a DNDarray, but was {type(x)}")
+        if x.ndim != 2:
+            raise ValueError(f"input needs to be 2D, but was {x.ndim}D")
+        data = x.larray.astype(jnp.promote_types(x.dtype.jax_type(), jnp.float32))
+        centers = self._initialize_cluster_centers(x)
+
+        # iterations run in fused chunks of up to 8 per dispatch; convergence
+        # is checked at chunk boundaries (coarser than the reference's
+        # per-iteration check, identical fixed point)
+        labels = None
+        inertia = None
+        done = 0
+        while done < self.max_iter:
+            chunk = min(8, self.max_iter - done)
+            centers, labels, inertia, shift = _lloyd_run(data, centers, self.n_clusters, chunk)
+            done += chunk
+            if float(shift) <= self.tol:
+                break
+
+        self._n_iter = done
+        self._inertia = float(inertia) if inertia is not None else None
+        self._cluster_centers = DNDarray(
+            _ensure_split(centers, None, x.comm),
+            tuple(centers.shape),
+            types.canonical_heat_type(centers.dtype),
+            None,
+            x.device,
+            x.comm,
+        )
+        self._labels = self._wrap_labels(labels, x)
+        return self

@@ -27,5 +27,12 @@ from .exponential import *
 from .trigonometrics import *
 from .complex_math import *
 from .printing import *
+from .statistics import *
+from .io import *
+from . import io
+from .manipulations import *
+from .indexing import *
+from .signal import *
+from . import random
 from . import linalg
 from .linalg import *

@@ -1,0 +1,50 @@
+"""Index finding operations (reference: heat/core/indexing.py)."""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax.numpy as jnp
+
+from . import sanitation, types
+from ._operations import __binary_op as _binary_op
+from .dndarray import DNDarray, _ensure_split
+
+__all__ = ["nonzero", "where"]
+
+
+def nonzero(x: DNDarray) -> DNDarray:
+    """Indices of non-zero elements as an (nnz, ndim) array (reference
+    indexing.py:16-90; the reference corrects local indices by lshape offsets —
+    global indexing makes that moot)."""
+    sanitation.sanitize_in(x)
+    idx = jnp.nonzero(x.larray)
+    result = jnp.stack(idx, axis=1) if x.ndim > 1 else idx[0]
+    result = result.astype(types.index_dtype())
+    split = 0 if x.split is not None else None
+    result = _ensure_split(result, split, x.comm)
+    return DNDarray(
+        result, tuple(result.shape), types.canonical_heat_type(result.dtype), split, x.device, x.comm
+    )
+
+
+def where(cond: DNDarray, x=None, y=None) -> DNDarray:
+    """Ternary where / nonzero (reference indexing.py:91-151)."""
+    if x is None and y is None:
+        return nonzero(cond)
+    if x is None or y is None:
+        raise TypeError("either both or neither of x and y should be given")
+    if not isinstance(x, DNDarray) and not isinstance(y, DNDarray):
+        # both scalars/arrays: ht.where(a < 0, 0, 1) — the reference's
+        # canonical usage (indexing.py:120-135)
+        result = jnp.where(cond.larray.astype(bool), x, y)
+        result = _ensure_split(result, cond.split, cond.comm)
+        return DNDarray(
+            result,
+            tuple(result.shape),
+            types.canonical_heat_type(result.dtype),
+            cond.split,
+            cond.device,
+            cond.comm,
+        )
+    return _binary_op(lambda a, b: jnp.where(cond.larray.astype(bool), a, b), x, y)

@@ -1,0 +1,240 @@
+"""Parallel I/O (reference: heat/core/io.py).
+
+The reference's parallel HDF5 path has every MPI rank slice its own chunk
+(io.py:119-147) and write through the mpio driver or a token-ring of
+serialized writes (:198-226); CSV reads are split by byte ranges (:713-925).
+Under a single controller the device shards come from one host-side read that
+is then scattered by ``device_put`` — on a multi-host deployment each host
+reads its addressable slice (the same per-chunk slicing, via
+``jax.make_array_from_callback``). netCDF support is gated on the library's
+presence (absent in this environment).
+"""
+
+from __future__ import annotations
+
+import csv as csv_module
+import os
+from typing import List, Optional, Tuple, Union
+
+import numpy as np
+
+from . import devices as devices_module
+from . import factories, types
+from .communication import sanitize_comm
+from .dndarray import DNDarray
+
+try:
+    import h5py
+
+    __HDF5_EXTENSIONS = frozenset([".h5", ".hdf5"])
+    __HAS_HDF5 = True
+except ImportError:  # pragma: no cover
+    __HAS_HDF5 = False
+    __HDF5_EXTENSIONS = frozenset()
+
+try:  # pragma: no cover - netCDF4 absent in this environment
+    import netCDF4 as nc
+
+    __HAS_NETCDF = True
+except ImportError:
+    __HAS_NETCDF = False
+
+__CSV_EXTENSION = frozenset([".csv"])
+__NETCDF_EXTENSIONS = frozenset([".nc", ".nc4", ".netcdf"])
+
+__all__ = [
+    "load",
+    "load_csv",
+    "load_hdf5",
+    "load_netcdf",
+    "save",
+    "save_csv",
+    "save_hdf5",
+    "save_netcdf",
+    "supports_hdf5",
+    "supports_netcdf",
+]
+
+
+def supports_hdf5() -> bool:
+    """True if HDF5 I/O is available (reference io.py:40-48)."""
+    return __HAS_HDF5
+
+
+def supports_netcdf() -> bool:
+    """True if netCDF I/O is available (reference io.py:49-57)."""
+    return __HAS_NETCDF
+
+
+def load(path: str, *args, **kwargs) -> DNDarray:
+    """Load by file extension (reference io.py:662-712)."""
+    if not isinstance(path, str):
+        raise TypeError(f"Expected path to be str, but was {type(path)}")
+    extension = os.path.splitext(path)[-1].strip().lower()
+    if extension in __CSV_EXTENSION:
+        return load_csv(path, *args, **kwargs)
+    if extension in __HDF5_EXTENSIONS:
+        if not supports_hdf5():
+            raise RuntimeError("hdf5 is required for file extension {}".format(extension))
+        return load_hdf5(path, *args, **kwargs)
+    if extension in __NETCDF_EXTENSIONS:
+        if not supports_netcdf():
+            raise RuntimeError("netcdf is required for file extension {}".format(extension))
+        return load_netcdf(path, *args, **kwargs)
+    raise ValueError(f"Unsupported file extension {extension}")
+
+
+def save(data: DNDarray, path: str, *args, **kwargs) -> None:
+    """Save by file extension (reference io.py:1060-1110)."""
+    if not isinstance(path, str):
+        raise TypeError(f"Expected path to be str, but was {type(path)}")
+    extension = os.path.splitext(path)[-1].strip().lower()
+    if extension in __CSV_EXTENSION:
+        return save_csv(data, path, *args, **kwargs)
+    if extension in __HDF5_EXTENSIONS:
+        if not supports_hdf5():
+            raise RuntimeError("hdf5 is required for file extension {}".format(extension))
+        return save_hdf5(data, path, *args, **kwargs)
+    if extension in __NETCDF_EXTENSIONS:
+        if not supports_netcdf():
+            raise RuntimeError("netcdf is required for file extension {}".format(extension))
+        return save_netcdf(data, path, *args, **kwargs)
+    raise ValueError(f"Unsupported file extension {extension}")
+
+
+# ----------------------------------------------------------------------------
+# HDF5 (reference io.py:58-245)
+# ----------------------------------------------------------------------------
+def load_hdf5(
+    path: str,
+    dataset: str,
+    dtype=types.float32,
+    load_fraction: float = 1.0,
+    split: Optional[int] = None,
+    device=None,
+    comm=None,
+) -> DNDarray:
+    """Load an HDF5 dataset (reference io.py:58-147)."""
+    if not isinstance(path, str):
+        raise TypeError(f"path must be str, but was {type(path)}")
+    if not isinstance(dataset, str):
+        raise TypeError(f"dataset must be str, but was {type(dataset)}")
+    if not isinstance(load_fraction, float):
+        raise TypeError(f"load_fraction must be float, but was {type(load_fraction)}")
+    if load_fraction <= 0.0 or load_fraction > 1.0:
+        raise ValueError(f"load_fraction must be in (0, 1], but was {load_fraction}")
+    with h5py.File(path, "r") as handle:
+        data = handle[dataset]
+        if load_fraction < 1.0 and split == 0:
+            n = int(data.shape[0] * load_fraction)
+            arr = np.asarray(data[:n])
+        else:
+            arr = np.asarray(data)
+    return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
+
+
+def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs) -> None:
+    """Save to an HDF5 dataset (reference io.py:148-245)."""
+    if not isinstance(data, DNDarray):
+        raise TypeError(f"data must be heat tensor, but was {type(data)}")
+    if not isinstance(path, str):
+        raise TypeError(f"path must be str, but was {type(path)}")
+    if not isinstance(dataset, str):
+        raise TypeError(f"dataset must be str, but was {type(dataset)}")
+    if mode not in ("w", "a", "r+"):
+        raise ValueError(f"mode was {mode}, not in possible modes ('w', 'a', 'r+')")
+    with h5py.File(path, mode) as handle:
+        handle.create_dataset(dataset, data=data.numpy(), **kwargs)
+
+
+# ----------------------------------------------------------------------------
+# netCDF (reference io.py:246-661) — gated
+# ----------------------------------------------------------------------------
+def load_netcdf(
+    path: str, variable: str, dtype=types.float32, split: Optional[int] = None, device=None, comm=None
+) -> DNDarray:
+    """Load a netCDF variable (reference io.py:246-414)."""
+    if not supports_netcdf():
+        raise RuntimeError("netCDF4 is not available in this environment")
+    with nc.Dataset(path, "r") as handle:  # pragma: no cover
+        arr = np.asarray(handle[variable][:])
+    return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)  # pragma: no cover
+
+
+def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwargs) -> None:
+    """Save to a netCDF variable (reference io.py:415-661)."""
+    if not supports_netcdf():
+        raise RuntimeError("netCDF4 is not available in this environment")
+    with nc.Dataset(path, mode) as handle:  # pragma: no cover
+        arr = data.numpy()
+        dims = []
+        for i, s in enumerate(arr.shape):
+            name = f"dim_{variable}_{i}"
+            handle.createDimension(name, s)
+            dims.append(name)
+        var = handle.createVariable(variable, arr.dtype, tuple(dims))
+        var[:] = arr
+
+
+# ----------------------------------------------------------------------------
+# CSV (reference io.py:713-1059)
+# ----------------------------------------------------------------------------
+def load_csv(
+    path: str,
+    header_lines: int = 0,
+    sep: str = ",",
+    dtype=types.float32,
+    encoding: str = "utf-8",
+    split: Optional[int] = None,
+    device=None,
+    comm=None,
+) -> DNDarray:
+    """Load a CSV file (reference io.py:713-925: byte-range splitting per rank;
+    one host read here, sharded on ingest)."""
+    if not isinstance(path, str):
+        raise TypeError(f"path must be str, but was {type(path)}")
+    if not isinstance(sep, str):
+        raise TypeError(f"separator must be str, but was {type(sep)}")
+    if not isinstance(header_lines, int):
+        raise TypeError(f"header_lines must be int, but was {type(header_lines)}")
+    npdtype = np.dtype(types.canonical_heat_type(dtype).jax_type())
+    rows: List[List[float]] = []
+    with open(path, "r", encoding=encoding) as f:
+        for i, line in enumerate(f):
+            if i < header_lines:
+                continue
+            line = line.strip()
+            if not line:
+                continue
+            rows.append([float(v) for v in line.split(sep)])
+    arr = np.asarray(rows, dtype=npdtype)
+    return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
+
+
+def save_csv(
+    data: DNDarray,
+    path: str,
+    header_lines: Optional[List[str]] = None,
+    sep: str = ",",
+    decimals: int = -1,
+    encoding: str = "utf-8",
+    **kwargs,
+) -> None:
+    """Save to CSV (reference io.py:926-1059)."""
+    if not isinstance(data, DNDarray):
+        raise TypeError(f"data must be a DNDarray, but was {type(data)}")
+    if not isinstance(path, str):
+        raise TypeError(f"path must be str, but was {type(path)}")
+    if data.ndim > 2:
+        raise ValueError("CSV can only store 1-D or 2-D arrays")
+    arr = data.numpy()
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    fmt = f"%.{decimals}f" if decimals >= 0 else "%s"
+    with open(path, "w", encoding=encoding, newline="") as f:
+        if header_lines:
+            for line in header_lines:
+                f.write(line if line.endswith("\n") else line + "\n")
+        writer = csv_module.writer(f, delimiter=sep)
+        for row in arr:
+            writer.writerow([fmt % v if decimals >= 0 else v for v in row])

@@ -1,0 +1,46 @@
+"""Signal processing (reference: heat/core/signal.py).
+
+The reference's distributed 1-D convolution exchanges halos between
+split-axis neighbors (signal.py:86-130 via dndarray.get_halo :360-441) and
+then runs a local conv1d. Under the global view, one sharded XLA convolution
+covers both steps: GSPMD inserts the boundary collective-permutes the halo
+exchange performed by hand in the reference.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import factories, sanitation, types
+from .dndarray import DNDarray, _ensure_split
+
+__all__ = ["convolve"]
+
+
+def convolve(a, v, mode: str = "full") -> DNDarray:
+    """1-D convolution of ``a`` with kernel ``v`` (reference signal.py:16-148)."""
+    if not isinstance(a, DNDarray):
+        a = factories.array(a)
+    if not isinstance(v, DNDarray):
+        v = factories.array(v)
+    if a.ndim != 1 or v.ndim != 1:
+        raise ValueError("Only 1-dimensional input DNDarrays are allowed")
+    if mode not in ("full", "same", "valid"):
+        raise ValueError(f"Supported modes are 'full', 'same', 'valid', got {mode!r}")
+    if mode == "same" and v.shape[0] % 2 == 0:
+        raise ValueError("Mode 'same' cannot be used with even-sized kernel")
+    if a.shape[0] < v.shape[0]:
+        a, v = v, a
+
+    promoted = types.promote_types(a.dtype, v.dtype)
+    if types.heat_type_is_exact(promoted):
+        promoted = types.promote_types(promoted, types.float32)
+    al = a.larray.astype(promoted.jax_type())
+    vl = v.larray.astype(promoted.jax_type())
+    result = jnp.convolve(al, vl, mode=mode)
+    split = a.split
+    result = _ensure_split(result, split, a.comm)
+    return DNDarray(
+        result, tuple(result.shape), types.canonical_heat_type(result.dtype), split, a.device, a.comm
+    )

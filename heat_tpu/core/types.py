@@ -438,3 +438,11 @@ class iinfo:
         self.min = builtins.int(info.min)
         self.dtype = ht_dtype
         return self
+
+
+def index_dtype():
+    """int64 under x64 mode, else int32 — avoids JAX's truncation warning on
+    TPU where 64-bit types are disabled."""
+    import jax
+
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32

@@ -1,0 +1,78 @@
+"""Bundled datasets (reference: heat/datasets/ ships iris.csv/h5/nc and
+diabetes.h5 as static files for tests and examples).
+
+This package generates equivalent small datasets on demand instead of
+shipping binaries: deterministic synthetic analogs with the same shapes
+((150, 4) three-class "iris-like" blobs; (442, 10) regression "diabetes-like"
+data), plus writers to materialize them as CSV/HDF5 for I/O-path exercises.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..core import factories
+from ..core.dndarray import DNDarray
+
+__all__ = ["iris_like", "diabetes_like", "materialize"]
+
+_IRIS_CENTERS = np.array(
+    [
+        [5.0, 3.4, 1.5, 0.25],
+        [5.9, 2.8, 4.3, 1.3],
+        [6.6, 3.0, 5.6, 2.0],
+    ],
+    dtype=np.float32,
+)
+_IRIS_STD = np.array([0.35, 0.35, 0.3, 0.2], dtype=np.float32)
+
+
+def iris_like(split: Optional[int] = None, return_labels: bool = False):
+    """A deterministic (150, 4) three-class dataset with iris-like cluster
+    geometry, for estimator convergence tests (stand-in for the reference's
+    heat/datasets/iris.h5)."""
+    rng = np.random.default_rng(1234)
+    xs, ys = [], []
+    for i, c in enumerate(_IRIS_CENTERS):
+        xs.append(rng.normal(c, _IRIS_STD, size=(50, 4)).astype(np.float32))
+        ys.append(np.full(50, i, dtype=np.int32))
+    x = np.concatenate(xs)
+    y = np.concatenate(ys)
+    data = factories.array(x, split=split)
+    if return_labels:
+        return data, factories.array(y, split=split)
+    return data
+
+
+def diabetes_like(split: Optional[int] = None):
+    """A deterministic (442, 10) standardized regression dataset (stand-in for
+    the reference's heat/datasets/diabetes.h5)."""
+    rng = np.random.default_rng(5678)
+    x = rng.standard_normal((442, 10)).astype(np.float32)
+    x = (x - x.mean(0)) / x.std(0)
+    return factories.array(x, split=split)
+
+
+def materialize(directory: str) -> dict:
+    """Write the generated datasets as iris.csv/iris.h5/diabetes.h5 under
+    ``directory`` and return the paths — mirrors the reference's on-disk
+    layout for I/O tests and examples."""
+    from ..core import io
+
+    os.makedirs(directory, exist_ok=True)
+    paths = {}
+    iris = iris_like()
+    iris_csv = os.path.join(directory, "iris.csv")
+    io.save_csv(iris, iris_csv)
+    paths["iris.csv"] = iris_csv
+    if io.supports_hdf5():
+        iris_h5 = os.path.join(directory, "iris.h5")
+        io.save_hdf5(iris, iris_h5, "data")
+        paths["iris.h5"] = iris_h5
+        diabetes_h5 = os.path.join(directory, "diabetes.h5")
+        io.save_hdf5(diabetes_like(), diabetes_h5, "x")
+        paths["diabetes.h5"] = diabetes_h5
+    return paths

@@ -1,0 +1,3 @@
+"""Distributed graph algorithms (reference: heat/graph/__init__.py)."""
+
+from .laplacian import *

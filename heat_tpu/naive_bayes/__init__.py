@@ -1,0 +1,3 @@
+"""Distributed naive Bayes (reference: heat/naive_bayes/__init__.py)."""
+
+from .gaussianNB import *

@@ -1,0 +1,34 @@
+"""Neural network stack.
+
+The reference re-exports ``torch.nn`` attributes dynamically and adds
+``DataParallel`` (reference heat/nn/__init__.py:19-31). The TPU-native module
+library is flax.linen, re-exported here the same way: ``heat_tpu.nn.Dense``,
+``heat_tpu.nn.Conv``, ``heat_tpu.nn.relu``... resolve to flax.linen, while
+``DataParallel``/``DataParallelMultiGPU`` and the model zoo are native.
+"""
+
+from . import models
+from .data_parallel import DataParallel, DataParallelMultiGPU
+from .models import MLP, ResNet, ResNet18, ResNet50, SimpleCNN
+
+import flax.linen as _linen
+
+__all__ = [
+    "DataParallel",
+    "DataParallelMultiGPU",
+    "MLP",
+    "SimpleCNN",
+    "ResNet",
+    "ResNet18",
+    "ResNet50",
+    "models",
+]
+
+
+def __getattr__(name):
+    # dynamic fallback to the backing NN library, mirroring the reference's
+    # torch.nn shim (heat/nn/__init__.py:19-31)
+    try:
+        return getattr(_linen, name)
+    except AttributeError:
+        raise AttributeError(f"module 'heat_tpu.nn' has no attribute {name!r}")

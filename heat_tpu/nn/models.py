@@ -1,0 +1,136 @@
+"""Reference network definitions, TPU-first.
+
+The reference has no model zoo (its examples build torch CNNs inline,
+examples/nn/mnist.py:20-48); this module provides the flagship models the
+benchmarks need, designed for the MXU: NHWC layouts, channel counts in
+multiples of 8/128, bfloat16-friendly, no data-dependent control flow.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as fnn
+import jax.numpy as jnp
+
+__all__ = ["MLP", "SimpleCNN", "ResNet", "ResNet18", "ResNet50", "BasicBlock", "Bottleneck"]
+
+
+class MLP(fnn.Module):
+    """Small multilayer perceptron (the reference's mnist example net shape)."""
+
+    features: Sequence[int] = (128, 10)
+    dtype: Any = jnp.float32
+
+    @fnn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for feat in self.features[:-1]:
+            x = fnn.relu(fnn.Dense(feat, dtype=self.dtype)(x))
+        return fnn.Dense(self.features[-1], dtype=self.dtype)(x)
+
+
+class SimpleCNN(fnn.Module):
+    """Conv net matching the reference example (examples/nn/mnist.py:20-48)."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @fnn.compact
+    def __call__(self, x):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = x.astype(self.dtype)
+        x = fnn.relu(fnn.Conv(32, (3, 3), dtype=self.dtype)(x))
+        x = fnn.relu(fnn.Conv(64, (3, 3), dtype=self.dtype)(x))
+        x = fnn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = fnn.relu(fnn.Dense(128, dtype=self.dtype)(x))
+        return fnn.Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+class BasicBlock(fnn.Module):
+    """3x3+3x3 residual block (ResNet-18/34)."""
+
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.float32
+
+    @fnn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(fnn.BatchNorm, use_running_average=not train, dtype=self.dtype)
+        residual = x
+        y = fnn.Conv(self.filters, (3, 3), self.strides, padding=1, use_bias=False, dtype=self.dtype)(x)
+        y = fnn.relu(norm()(y))
+        y = fnn.Conv(self.filters, (3, 3), padding=1, use_bias=False, dtype=self.dtype)(y)
+        y = norm(scale_init=fnn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = fnn.Conv(
+                self.filters, (1, 1), self.strides, use_bias=False, dtype=self.dtype
+            )(residual)
+            residual = norm()(residual)
+        return fnn.relu(y + residual)
+
+
+class Bottleneck(fnn.Module):
+    """1x1-3x3-1x1 bottleneck block (ResNet-50/101/152)."""
+
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.float32
+
+    @fnn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(fnn.BatchNorm, use_running_average=not train, dtype=self.dtype)
+        residual = x
+        y = fnn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        y = fnn.relu(norm()(y))
+        y = fnn.Conv(self.filters, (3, 3), self.strides, padding=1, use_bias=False, dtype=self.dtype)(y)
+        y = fnn.relu(norm()(y))
+        y = fnn.Conv(self.filters * 4, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        y = norm(scale_init=fnn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = fnn.Conv(
+                self.filters * 4, (1, 1), self.strides, use_bias=False, dtype=self.dtype
+            )(residual)
+            residual = norm()(residual)
+        return fnn.relu(y + residual)
+
+
+class ResNet(fnn.Module):
+    """CIFAR-style ResNet (3x3 stem, no max-pool) in NHWC.
+
+    stage_sizes/block pick the variant; dtype=jnp.bfloat16 runs the matmuls
+    and convs on the MXU at full rate with float32 batch-norm statistics.
+    """
+
+    stage_sizes: Sequence[int]
+    block: Any = BasicBlock
+    num_classes: int = 10
+    num_filters: int = 64
+    dtype: Any = jnp.float32
+
+    @fnn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(fnn.BatchNorm, use_running_average=not train, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = fnn.Conv(self.num_filters, (3, 3), padding=1, use_bias=False, dtype=self.dtype)(x)
+        x = fnn.relu(norm()(x))
+        for i, size in enumerate(self.stage_sizes):
+            for j in range(size):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block(
+                    self.num_filters * 2**i, strides=strides, dtype=self.dtype
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = fnn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+def ResNet18(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
+    return ResNet(stage_sizes=(2, 2, 2, 2), block=BasicBlock, num_classes=num_classes, dtype=dtype)
+
+
+def ResNet50(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block=Bottleneck, num_classes=num_classes, dtype=dtype)

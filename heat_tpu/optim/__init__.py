@@ -1,0 +1,37 @@
+"""Optimizers.
+
+The reference re-exports ``torch.optim`` attributes dynamically and adds the
+data-parallel wrappers (reference heat/optim/__init__.py:18-36). The backing
+optimizer library here is optax, shimmed the same way with the familiar
+torch-style names: ``heat_tpu.optim.SGD(lr)`` → ``optax.sgd``, ``Adam`` →
+``optax.adam``, etc. — all returning optax gradient transformations.
+"""
+
+import optax as _optax
+
+from . import utils
+from .dp_optimizer import DASO, DataParallelOptimizer
+from .utils import DetectMetricPlateau
+
+__all__ = ["DASO", "DataParallelOptimizer", "DetectMetricPlateau", "utils"]
+
+_TORCH_STYLE = {
+    "SGD": _optax.sgd,
+    "Adam": _optax.adam,
+    "AdamW": _optax.adamw,
+    "Adagrad": _optax.adagrad,
+    "RMSprop": _optax.rmsprop,
+    "Adadelta": _optax.adadelta,
+    "LBFGS": _optax.lbfgs,
+}
+
+
+def __getattr__(name):
+    # dynamic fallback mirroring the reference's torch.optim shim
+    # (heat/optim/__init__.py:18-36)
+    if name in _TORCH_STYLE:
+        return _TORCH_STYLE[name]
+    try:
+        return getattr(_optax, name)
+    except AttributeError:
+        raise AttributeError(f"module 'heat_tpu.optim' has no attribute {name!r}")

@@ -1,0 +1,3 @@
+"""Distributed regression (reference: heat/regression/__init__.py)."""
+
+from .lasso import *

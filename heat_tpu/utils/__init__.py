@@ -1,0 +1,3 @@
+"""Utility subpackages (reference: heat/utils/__init__.py)."""
+
+from . import data

@@ -1,0 +1,11 @@
+"""Data-loading utilities (reference: heat/utils/data/__init__.py)."""
+
+from . import datatools, matrixgallery, partial_dataset
+from .datatools import *
+from .matrixgallery import *
+from .partial_dataset import *
+
+try:  # torchvision-backed MNIST dataset is optional (reference mnist.py)
+    from .mnist import MNISTDataset
+except Exception:  # pragma: no cover
+    MNISTDataset = None

@@ -1,0 +1,130 @@
+"""Dataset and DataLoader (reference: heat/utils/data/datatools.py).
+
+The reference keeps each rank's shard in memory and reshuffles globally
+between epochs by Alltoall-ing half-shards (datatools.py:246-343). Here the
+dataset holds the global (sharded) arrays; the inter-epoch shuffle is one
+global permutation gather whose collectives XLA derives — same effect, one
+line. Batches are yielded as device arrays ready for a jitted train step.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import random as ht_random
+from ...core.dndarray import DNDarray
+
+__all__ = ["DataLoader", "Dataset", "dataset_shuffle", "dataset_ishuffle"]
+
+
+class Dataset:
+    """In-memory dataset over one or more aligned arrays (reference
+    datatools.py:30-148).
+
+    Parameters
+    ----------
+    array : DNDarray or sequence of DNDarray
+        Data (and optionally labels, etc.), first axes aligned.
+    transform : callable, optional
+        Applied per retrieved item.
+    ishuffle : bool
+        Kept for API parity; shuffling happens in the DataLoader.
+    """
+
+    def __init__(self, array, transform=None, ishuffle: bool = False, test_set=None):
+        if isinstance(array, DNDarray):
+            self.arrays = [array]
+        else:
+            self.arrays = list(array)
+        n = self.arrays[0].shape[0]
+        for a in self.arrays[1:]:
+            if a.shape[0] != n:
+                raise ValueError("all arrays must have the same first dimension")
+        self.transform = transform
+        self.ishuffle = ishuffle
+        self.test_set = test_set
+
+    def __len__(self) -> int:
+        return self.arrays[0].shape[0]
+
+    def __getitem__(self, index):
+        items = [a.larray[index] for a in self.arrays]
+        if self.transform is not None:
+            items[0] = self.transform(items[0])
+        return items[0] if len(items) == 1 else tuple(items)
+
+    def shuffle(self):
+        """Global random permutation of all arrays (reference datatools.py:246-297)."""
+        n = len(self)
+        perm = ht_random.randperm(n).larray
+        for a in self.arrays:
+            a.larray = jnp.take(a.larray, perm, axis=0)
+
+    def ishuffle_(self):
+        """Non-blocking shuffle in the reference (:298-343); dispatch is async
+        under JAX anyway, so this is the same global permutation."""
+        self.shuffle()
+
+
+class DataLoader:
+    """Iterator of device-ready batches (reference datatools.py:149-245).
+
+    Parameters
+    ----------
+    dataset : Dataset or DNDarray
+    batch_size : int
+    shuffle : bool
+        Reshuffle globally at the start of every epoch.
+    drop_last : bool
+        Drop the trailing ragged batch (True keeps every batch jit-shape-stable).
+    """
+
+    def __init__(
+        self,
+        dataset=None,
+        batch_size: int = 1,
+        shuffle: bool = False,
+        drop_last: bool = True,
+        lcl_dataset=None,
+    ):
+        if dataset is None and lcl_dataset is not None:
+            dataset = lcl_dataset
+        if isinstance(dataset, DNDarray):
+            dataset = Dataset(dataset)
+        if not isinstance(dataset, Dataset):
+            raise TypeError(f"dataset must be a Dataset or DNDarray, got {type(dataset)}")
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be positive, got {batch_size}")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return -(-n // self.batch_size)
+
+    def __iter__(self) -> Iterator:
+        if self.shuffle:
+            self.dataset.shuffle()
+        n = len(self.dataset)
+        bs = self.batch_size
+        stop = (n // bs) * bs if self.drop_last else n
+        for start in range(0, stop, bs):
+            yield self.dataset[start : min(start + bs, n)]
+
+
+def dataset_shuffle(dataset: Dataset, attrs=None) -> None:
+    """Module-level shuffle hook (reference datatools.py:246-297)."""
+    dataset.shuffle()
+
+
+def dataset_ishuffle(dataset: Dataset, attrs=None) -> None:
+    """Non-blocking shuffle hook (reference datatools.py:298-343)."""
+    dataset.ishuffle_()

@@ -1,0 +1,58 @@
+"""Test-matrix generators (reference: heat/utils/data/matrixgallery.py)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import factories, types
+from ...core import random as ht_random
+from ...core.communication import sanitize_comm
+from ...core.dndarray import DNDarray
+
+__all__ = ["hermitian", "parter", "random_known_rank"]
+
+
+def parter(n: int, split: Optional[int] = None, device=None, comm=None, dtype=types.float32) -> DNDarray:
+    """Parter matrix A[i,j] = 1 / (i - j + 0.5) — a Cauchy matrix with
+    singular values clustered at pi (reference matrixgallery.py:14-56)."""
+    i = jnp.arange(n, dtype=types.canonical_heat_type(dtype).jax_type())
+    a = 1.0 / (i[:, None] - i[None, :] + 0.5)
+    return factories.array(a, split=split, device=device, comm=comm, dtype=dtype)
+
+
+def hermitian(
+    n: int, split: Optional[int] = None, device=None, comm=None, dtype=types.complex64, positive_definite: bool = False
+) -> DNDarray:
+    """Random Hermitian (or symmetric, for real dtypes) matrix (reference
+    matrixgallery.py:57-120)."""
+    cplx = types.heat_type_is_complexfloating(dtype)
+    real = ht_random.randn(n, n, split=split, device=device, comm=comm)
+    if cplx:
+        imag = ht_random.randn(n, n, split=split, device=device, comm=comm)
+        a = real.larray + 1j * imag.larray
+    else:
+        a = real.larray
+    if positive_definite:
+        h = a @ jnp.conj(a.T) + n * jnp.eye(n, dtype=a.dtype)
+    else:
+        h = 0.5 * (a + jnp.conj(a.T))
+    return factories.array(h, split=split, device=device, comm=comm, dtype=dtype)
+
+
+def random_known_rank(
+    m: int, n: int, rank: int, split: Optional[int] = None, device=None, comm=None, dtype=types.float32
+) -> Tuple[DNDarray, Tuple[DNDarray, DNDarray]]:
+    """Random matrix of known rank, returned with its factors (reference
+    matrixgallery.py:121-170)."""
+    if rank > min(m, n):
+        raise ValueError(f"rank must be <= min(m, n) = {min(m, n)}, got {rank}")
+    u = ht_random.randn(m, rank, split=split, device=device, comm=comm)
+    v = ht_random.randn(n, rank, device=device, comm=comm)
+    a = u.larray @ v.larray.T
+    return (
+        factories.array(a, split=split, device=device, comm=comm, dtype=dtype),
+        (u, v),
+    )

@@ -1,0 +1,40 @@
+"""MNIST dataset wrapper (reference: heat/utils/data/mnist.py:16-127).
+
+The reference subclasses torchvision's MNIST and slices each rank's shard.
+torchvision is optional here; when present, the data is ingested into the
+sharded Dataset machinery.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...core import factories
+from .datatools import Dataset
+
+__all__ = ["MNISTDataset"]
+
+
+class MNISTDataset(Dataset):
+    """MNIST as a sharded in-memory Dataset (reference mnist.py:16-127).
+
+    Parameters
+    ----------
+    root : str
+        Download/cache directory.
+    train : bool
+    transform : callable, optional
+    split : int or None
+        Heat split axis for the image array (0 shards samples over devices).
+    """
+
+    def __init__(self, root: str, train: bool = True, transform=None, target_transform=None, split=0):
+        from torchvision import datasets as tv_datasets  # noqa: deferred optional dep
+
+        base = tv_datasets.MNIST(root, train=train, download=True)
+        images = np.asarray(base.data.numpy(), dtype=np.float32) / 255.0
+        labels = np.asarray(base.targets.numpy(), dtype=np.int32)
+        img = factories.array(images, split=split)
+        lbl = factories.array(labels, split=split)
+        super().__init__([img, lbl], transform=transform)
+        self.train = train

@@ -1,0 +1,158 @@
+"""Tests for io, utils.data, datasets (reference model: heat/core/tests/
+test_io.py, heat/utils/data/tests)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from harness import TestCase
+
+
+class TestIO(TestCase):
+    def setUp(self):
+        self.tmp = tempfile.mkdtemp()
+
+    def test_hdf5_roundtrip(self):
+        self.assertTrue(ht.supports_hdf5())
+        path = os.path.join(self.tmp, "data.h5")
+        rng = np.random.default_rng(0)
+        a = rng.random((20, 4)).astype(np.float32)
+        x = ht.array(a, split=0)
+        ht.save_hdf5(x, path, "data")
+        for split in (None, 0, 1):
+            y = ht.load_hdf5(path, "data", split=split)
+            np.testing.assert_allclose(y.numpy(), a, rtol=1e-6)
+            self.assertEqual(y.split, split)
+        # dispatch by extension
+        z = ht.load(path, "data", split=0)
+        np.testing.assert_allclose(z.numpy(), a, rtol=1e-6)
+        ht.save(x, os.path.join(self.tmp, "d2.h5"), "data")
+        frac = ht.load_hdf5(path, "data", load_fraction=0.5, split=0)
+        self.assertEqual(frac.shape[0], 10)
+        with pytest.raises(ValueError):
+            ht.load_hdf5(path, "data", load_fraction=0.0, split=0)
+        with pytest.raises(TypeError):
+            ht.load_hdf5(1, "data")
+        with pytest.raises(ValueError):
+            ht.save_hdf5(x, path, "data", mode="x")
+
+    def test_csv_roundtrip(self):
+        path = os.path.join(self.tmp, "data.csv")
+        a = np.arange(12.0, dtype=np.float32).reshape(4, 3)
+        ht.save_csv(ht.array(a, split=0), path)
+        y = ht.load_csv(path, split=0)
+        np.testing.assert_allclose(y.numpy(), a, rtol=1e-6)
+        # header lines + separator
+        path2 = os.path.join(self.tmp, "h.csv")
+        ht.save_csv(ht.array(a), path2, header_lines=["c1;c2;c3"], sep=";")
+        y2 = ht.load_csv(path2, header_lines=1, sep=";")
+        np.testing.assert_allclose(y2.numpy(), a, rtol=1e-6)
+        with pytest.raises(ValueError):
+            ht.save_csv(ht.ones((2, 2, 2)), path)
+        with pytest.raises(ValueError):
+            ht.load(os.path.join(self.tmp, "x.bin"))
+
+    def test_netcdf_gated(self):
+        if not ht.supports_netcdf():
+            with pytest.raises(RuntimeError):
+                ht.load_netcdf("x.nc", "var")
+            with pytest.raises(RuntimeError):
+                ht.save_netcdf(ht.ones(3), "x.nc", "var")
+
+
+class TestDataTools(TestCase):
+    def test_dataset_dataloader(self):
+        rng = np.random.default_rng(1)
+        X = rng.random((32, 3)).astype(np.float32)
+        y = np.arange(32, dtype=np.int32)
+        ds = ht.utils.data.Dataset([ht.array(X, split=0), ht.array(y, split=0)])
+        self.assertEqual(len(ds), 32)
+        item, label = ds[5]
+        self.assertEqual(int(label), 5)
+        dl = ht.utils.data.DataLoader(ds, batch_size=8)
+        self.assertEqual(len(dl), 4)
+        batches = list(dl)
+        self.assertEqual(len(batches), 4)
+        self.assertEqual(batches[0][0].shape, (8, 3))
+        # shuffled loader keeps the (x, y) pairing
+        ht.random.seed(0)
+        dl2 = ht.utils.data.DataLoader(ds, batch_size=8, shuffle=True)
+        for bx, by in dl2:
+            np.testing.assert_allclose(np.asarray(bx), X[np.asarray(by)], rtol=1e-6)
+        # drop_last=False keeps the ragged tail
+        dl3 = ht.utils.data.DataLoader(ht.arange(10, split=0), batch_size=4, drop_last=False)
+        sizes = [np.asarray(b).shape[0] for b in dl3]
+        self.assertEqual(sizes, [4, 4, 2])
+        with pytest.raises(ValueError):
+            ht.utils.data.DataLoader(ds, batch_size=0)
+        with pytest.raises(TypeError):
+            ht.utils.data.DataLoader("nope")
+        with pytest.raises(ValueError):
+            ht.utils.data.Dataset([ht.arange(4), ht.arange(5)])
+
+    def test_partial_h5(self):
+        import h5py
+
+        tmp = tempfile.mkdtemp()
+        path = os.path.join(tmp, "big.h5")
+        X = np.arange(200.0, dtype=np.float32).reshape(50, 4)
+        y = np.arange(50, dtype=np.int32)
+        with h5py.File(path, "w") as f:
+            f.create_dataset("x", data=X)
+            f.create_dataset("y", data=y)
+        ds = ht.utils.data.PartialH5Dataset(
+            path, dataset_names=["x", "y"], initial_load=20, load_length=10
+        )
+        self.assertEqual(len(ds), 50)
+        it = ht.utils.data.PartialH5DataLoaderIter(ds, batch_size=5, shuffle=True)
+        seen = 0
+        for bx, by in it:
+            np.testing.assert_allclose(bx, X[by], rtol=1e-6)
+            seen += bx.shape[0]
+        self.assertEqual(seen, 50)
+        with pytest.raises(TypeError):
+            iter(ds)
+
+    def test_matrixgallery(self):
+        p = ht.utils.data.parter(8, split=0)
+        self.assertEqual(p.shape, (8, 8))
+        expected = 1.0 / (np.arange(8)[:, None] - np.arange(8)[None, :] + 0.5)
+        np.testing.assert_allclose(p.numpy(), expected, rtol=1e-5)
+        h = ht.utils.data.hermitian(6, dtype=ht.complex64)
+        np.testing.assert_allclose(h.numpy(), h.numpy().conj().T, atol=1e-5)
+        hpd = ht.utils.data.hermitian(6, dtype=ht.float32, positive_definite=True)
+        ev = np.linalg.eigvalsh(hpd.numpy())
+        self.assertGreater(ev.min(), 0)
+        a, (u, v) = ht.utils.data.random_known_rank(10, 8, 3, split=0)
+        self.assertEqual(int(np.linalg.matrix_rank(a.numpy(), tol=1e-4)), 3)
+        with pytest.raises(ValueError):
+            ht.utils.data.random_known_rank(4, 4, 9)
+
+
+class TestDatasets(TestCase):
+    def test_generators(self):
+        x, y = ht.datasets.iris_like(split=0, return_labels=True)
+        self.assertEqual(x.shape, (150, 4))
+        self.assertEqual(y.shape, (150,))
+        d = ht.datasets.diabetes_like()
+        self.assertEqual(d.shape, (442, 10))
+        np.testing.assert_allclose(d.numpy().mean(0), 0.0, atol=1e-5)
+        # kmeans converges on iris-like data (reference test pattern:
+        # cluster/tests/test_kmeans.py on heat/datasets/iris.h5)
+        km = ht.cluster.KMeans(n_clusters=3, init="kmeans++", random_state=11)
+        km.fit(x)
+        self.assertEqual(km.cluster_centers_.shape, (3, 4))
+
+    def test_materialize(self):
+        tmp = tempfile.mkdtemp()
+        paths = ht.datasets.materialize(tmp)
+        self.assertIn("iris.csv", paths)
+        x = ht.load_csv(paths["iris.csv"], split=0)
+        self.assertEqual(x.shape, (150, 4))
+        if ht.supports_hdf5():
+            h = ht.load_hdf5(paths["iris.h5"], "data", split=0)
+            np.testing.assert_allclose(h.numpy(), x.numpy(), rtol=1e-4, atol=1e-4)

@@ -1,0 +1,276 @@
+"""Tests for manipulations, indexing, signal (reference model:
+heat/core/tests/test_manipulations.py — the reference's largest test file)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from harness import TestCase
+
+
+class TestJoinSplit(TestCase):
+    def test_concatenate(self):
+        a = np.arange(12.0, dtype=np.float32).reshape(4, 3)
+        b = np.arange(6.0, dtype=np.float32).reshape(2, 3)
+        for sa in (None, 0, 1):
+            for sb in (None, 0, 1):
+                r = ht.concatenate([ht.array(a, split=sa), ht.array(b, split=sb)], axis=0)
+                np.testing.assert_array_equal(r.numpy(), np.concatenate([a, b]))
+        r = ht.concatenate([ht.array(a, split=0), ht.array(a, split=0)], axis=1)
+        np.testing.assert_array_equal(r.numpy(), np.concatenate([a, a], axis=1))
+        self.assertEqual(r.split, 0)
+        # dtype promotion
+        r = ht.concatenate([ht.arange(3), ht.arange(3.0)])
+        self.assertIs(r.dtype, ht.float32)
+        with pytest.raises(TypeError):
+            ht.concatenate("abc")
+        with pytest.raises(ValueError):
+            ht.concatenate([])
+
+    def test_stack_family(self):
+        a = np.arange(6.0, dtype=np.float32).reshape(2, 3)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            np.testing.assert_array_equal(ht.stack([x, x]).numpy(), np.stack([a, a]))
+            np.testing.assert_array_equal(
+                ht.stack([x, x], axis=1).numpy(), np.stack([a, a], axis=1)
+            )
+            np.testing.assert_array_equal(ht.vstack([x, x]).numpy(), np.vstack([a, a]))
+            np.testing.assert_array_equal(ht.hstack([x, x]).numpy(), np.hstack([a, a]))
+        v = ht.arange(3, dtype=ht.float32)
+        np.testing.assert_array_equal(
+            ht.column_stack([v, v]).numpy(), np.column_stack([np.arange(3.0)] * 2)
+        )
+        np.testing.assert_array_equal(
+            ht.row_stack([v, v]).numpy(), np.vstack([np.arange(3.0)] * 2)
+        )
+        self.assertEqual(ht.stack([ht.array(a, split=0), ht.array(a, split=0)]).split, 1)
+        with pytest.raises(ValueError):
+            ht.stack([v])
+        with pytest.raises(ValueError):
+            ht.stack([ht.ones((2, 2)), ht.ones((2, 3))])
+
+    def test_split_family(self):
+        a = np.arange(24.0, dtype=np.float32).reshape(4, 6)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            parts = ht.split(x, 2, axis=1)
+            for p, e in zip(parts, np.split(a, 2, axis=1)):
+                np.testing.assert_array_equal(p.numpy(), e)
+            parts = ht.vsplit(x, 2)
+            for p, e in zip(parts, np.vsplit(a, 2)):
+                np.testing.assert_array_equal(p.numpy(), e)
+            parts = ht.hsplit(x, 3)
+            for p, e in zip(parts, np.hsplit(a, 3)):
+                np.testing.assert_array_equal(p.numpy(), e)
+        c = ht.array(np.arange(8.0, dtype=np.float32).reshape(2, 2, 2))
+        for p, e in zip(ht.dsplit(c, 2), np.dsplit(np.arange(8.0).reshape(2, 2, 2), 2)):
+            np.testing.assert_array_equal(p.numpy(), e)
+        with pytest.raises(ValueError):
+            ht.split(ht.arange(5), 2)
+
+
+class TestReshapeResplit(TestCase):
+    def test_reshape(self):
+        a = np.arange(24.0, dtype=np.float32)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            np.testing.assert_array_equal(x.reshape((4, 6)).numpy(), a.reshape(4, 6))
+            np.testing.assert_array_equal(x.reshape(2, 3, 4).numpy(), a.reshape(2, 3, 4))
+            np.testing.assert_array_equal(x.reshape((-1, 8)).numpy(), a.reshape(-1, 8))
+        m = ht.array(a.reshape(4, 6), split=1)
+        np.testing.assert_array_equal(m.reshape((6, 4)).numpy(), a.reshape(6, 4))
+        with pytest.raises(ValueError):
+            ht.reshape(ht.arange(10), (3, 5))
+        with pytest.raises(ValueError):
+            ht.reshape(ht.arange(10), (-1, -1))
+
+    def test_resplit(self):
+        a = np.arange(24.0, dtype=np.float32).reshape(6, 4)
+        x = ht.array(a, split=0)
+        y = ht.resplit(x, 1)
+        self.assertEqual(y.split, 1)
+        self.assertEqual(x.split, 0)  # out-of-place
+        np.testing.assert_array_equal(y.numpy(), a)
+        z = ht.resplit(x, None)
+        self.assertEqual(z.split, None)
+        np.testing.assert_array_equal(z.numpy(), a)
+        c = ht.collect(x)
+        self.assertEqual(c.split, None)
+
+    def test_flatten_ravel_squeeze_expand(self):
+        a = np.arange(24.0, dtype=np.float32).reshape(2, 3, 4)
+        for split in (None, 0, 1, 2):
+            x = ht.array(a, split=split)
+            np.testing.assert_array_equal(x.flatten().numpy(), a.flatten())
+            np.testing.assert_array_equal(ht.ravel(x).numpy(), a.ravel())
+        b = np.ones((1, 3, 1, 2), np.float32)
+        y = ht.array(b, split=1)
+        s = ht.squeeze(y)
+        np.testing.assert_array_equal(s.numpy(), b.squeeze())
+        self.assertEqual(s.split, 0)
+        np.testing.assert_array_equal(ht.squeeze(y, 0).numpy(), b.squeeze(0))
+        with pytest.raises(ValueError):
+            ht.squeeze(y, 1)
+        e = ht.expand_dims(ht.array(a, split=1), 0)
+        self.assertEqual(e.split, 2)
+        np.testing.assert_array_equal(e.numpy(), np.expand_dims(a, 0))
+
+
+class TestRearrange(TestCase):
+    def test_flip_roll_rot90(self):
+        a = np.arange(12.0, dtype=np.float32).reshape(3, 4)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            np.testing.assert_array_equal(ht.flip(x).numpy(), np.flip(a))
+            np.testing.assert_array_equal(ht.flip(x, 0).numpy(), np.flip(a, 0))
+            np.testing.assert_array_equal(ht.flipud(x).numpy(), np.flipud(a))
+            np.testing.assert_array_equal(ht.fliplr(x).numpy(), np.fliplr(a))
+            np.testing.assert_array_equal(ht.roll(x, 2).numpy(), np.roll(a, 2))
+            np.testing.assert_array_equal(ht.roll(x, 1, 0).numpy(), np.roll(a, 1, 0))
+            np.testing.assert_array_equal(
+                ht.roll(x, (1, 2), (0, 1)).numpy(), np.roll(a, (1, 2), (0, 1))
+            )
+            np.testing.assert_array_equal(ht.rot90(x).numpy(), np.rot90(a))
+            np.testing.assert_array_equal(ht.rot90(x, 2).numpy(), np.rot90(a, 2))
+        self.assertEqual(ht.rot90(ht.array(a, split=0)).split, 1)
+        with pytest.raises(IndexError):
+            ht.fliplr(ht.arange(3))
+
+    def test_moveaxis_swapaxes(self):
+        a = np.arange(24.0, dtype=np.float32).reshape(2, 3, 4)
+        x = ht.array(a, split=2)
+        np.testing.assert_array_equal(
+            ht.moveaxis(x, 0, 2).numpy(), np.moveaxis(a, 0, 2)
+        )
+        np.testing.assert_array_equal(ht.swapaxes(x, 0, 1).numpy(), np.swapaxes(a, 0, 1))
+        self.assertEqual(ht.swapaxes(ht.array(a, split=0), 0, 1).split, 1)
+        with pytest.raises(ValueError):
+            ht.moveaxis(x, (0, 1), (0,))
+
+    def test_pad_tile_repeat(self):
+        a = np.arange(6.0, dtype=np.float32).reshape(2, 3)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            np.testing.assert_array_equal(
+                ht.pad(x, ((1, 1), (2, 0)), constant_values=9).numpy(),
+                np.pad(a, ((1, 1), (2, 0)), constant_values=9),
+            )
+            np.testing.assert_array_equal(ht.tile(x, (2, 2)).numpy(), np.tile(a, (2, 2)))
+            np.testing.assert_array_equal(ht.repeat(x, 3).numpy(), np.repeat(a, 3))
+            np.testing.assert_array_equal(
+                ht.repeat(x, 2, axis=1).numpy(), np.repeat(a, 2, axis=1)
+            )
+        with pytest.raises(NotImplementedError):
+            ht.pad(ht.array(a), ((1, 1), (1, 1)), mode="edge")
+
+    def test_broadcast(self):
+        a = np.arange(3.0, dtype=np.float32)
+        x = ht.array(a)
+        b = ht.broadcast_to(x, (4, 3))
+        np.testing.assert_array_equal(b.numpy(), np.broadcast_to(a, (4, 3)))
+        r = ht.broadcast_arrays(ht.ones((4, 1)), ht.ones((1, 5)))
+        self.assertEqual(r[0].shape, (4, 5))
+        self.assertEqual(r[1].shape, (4, 5))
+        x = ht.array(a, split=0)
+        self.assertEqual(ht.broadcast_to(x, (4, 3)).split, 1)
+
+
+class TestSortSearch(TestCase):
+    def test_sort(self):
+        rng = np.random.default_rng(0)
+        a = rng.random((6, 8)).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            for axis in (0, 1, -1):
+                v, i = ht.sort(x, axis=axis)
+                np.testing.assert_allclose(v.numpy(), np.sort(a, axis=axis))
+                np.testing.assert_array_equal(i.numpy(), np.argsort(a, axis=axis, kind="stable"))
+            v, i = ht.sort(x, axis=0, descending=True)
+            np.testing.assert_allclose(v.numpy(), -np.sort(-a, axis=0))
+
+    def test_topk(self):
+        a = np.array([[9.0, 1.0, 5.0, 3.0], [2.0, 8.0, 4.0, 6.0]], dtype=np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            v, i = ht.topk(x, 2)
+            np.testing.assert_allclose(v.numpy(), np.array([[9.0, 5.0], [8.0, 6.0]]))
+            v2, i2 = ht.topk(x, 2, largest=False)
+            np.testing.assert_allclose(v2.numpy(), np.array([[1.0, 3.0], [2.0, 4.0]]))
+        v, i = ht.topk(ht.array(a, split=0), 1, dim=0)
+        np.testing.assert_allclose(v.numpy(), a.max(0, keepdims=True))
+        with pytest.raises(ValueError):
+            ht.topk(ht.arange(3), 5)
+
+    def test_unique(self):
+        a = np.array([3, 1, 2, 1, 3, 2, 9], dtype=np.int32)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            u = ht.unique(x, sorted=True)
+            np.testing.assert_array_equal(u.numpy(), np.unique(a))
+            u, inv = ht.unique(x, return_inverse=True)
+            np.testing.assert_array_equal(u.numpy()[inv.numpy()], a)
+        m = np.array([[1, 2], [1, 2], [3, 4]], dtype=np.int32)
+        u = ht.unique(ht.array(m, split=0), axis=0)
+        np.testing.assert_array_equal(u.numpy(), np.unique(m, axis=0))
+
+    def test_nonzero_where(self):
+        a = np.array([[0.0, 1.0, 0.0], [2.0, 0.0, 3.0]], dtype=np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            nz = ht.nonzero(x)
+            np.testing.assert_array_equal(nz.numpy(), np.stack(np.nonzero(a), axis=1))
+            w = ht.where(x > 0, x, -1.0)
+            np.testing.assert_allclose(w.numpy(), np.where(a > 0, a, -1))
+        v = ht.array(np.array([0.0, 5.0, 0.0, 2.0], dtype=np.float32), split=0)
+        np.testing.assert_array_equal(ht.nonzero(v).numpy(), np.nonzero(v.numpy())[0])
+        np.testing.assert_array_equal(ht.where(v > 0).numpy(), np.nonzero(v.numpy())[0])
+        # both-scalar branch (the reference's canonical ht.where(a < 0, 0, 1))
+        np.testing.assert_array_equal(
+            ht.where(v > 0, 1.0, 0.0).numpy(), np.where(v.numpy() > 0, 1.0, 0.0)
+        )
+        self.assertEqual(ht.where(v > 0, 1.0, 0.0).split, 0)
+        with pytest.raises(TypeError):
+            ht.where(v > 0, v)
+
+
+class TestDiag(TestCase):
+    def test_diag_diagonal(self):
+        a = np.arange(16.0, dtype=np.float32).reshape(4, 4)
+        v = np.arange(4.0, dtype=np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            np.testing.assert_array_equal(ht.diag(x).numpy(), np.diag(a))
+            np.testing.assert_array_equal(ht.diagonal(x, offset=1).numpy(), np.diagonal(a, 1))
+        d = ht.diag(ht.array(v, split=0))
+        np.testing.assert_array_equal(d.numpy(), np.diag(v))
+        self.assertEqual(d.split, 0)
+        with pytest.raises(ValueError):
+            ht.diagonal(ht.array(a), dim1=0, dim2=0)
+
+
+class TestSignal(TestCase):
+    def test_convolve(self):
+        a = np.array([1.0, 2.0, 3.0, 4.0, 5.0], dtype=np.float32)
+        v = np.array([0.5, 1.0, 0.5], dtype=np.float32)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            k = ht.array(v)
+            for mode in ("full", "same", "valid"):
+                np.testing.assert_allclose(
+                    ht.convolve(x, k, mode=mode).numpy(), np.convolve(a, v, mode=mode), rtol=1e-5
+                )
+        # kernel longer than signal swaps
+        np.testing.assert_allclose(
+            ht.convolve(ht.array(v), ht.array(a)).numpy(), np.convolve(v, a), rtol=1e-5
+        )
+        # int inputs promote to float
+        r = ht.convolve(ht.arange(5), ht.array([1, 1, 1]))
+        self.assertIs(r.dtype, ht.float32)
+        with pytest.raises(ValueError):
+            ht.convolve(ht.ones((2, 2)), k)
+        with pytest.raises(ValueError):
+            ht.convolve(x, ht.array([1.0, 1.0]), mode="same")
+        with pytest.raises(ValueError):
+            ht.convolve(x, k, mode="bad")

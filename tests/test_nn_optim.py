@@ -1,0 +1,160 @@
+"""Tests for the DL stack: nn.DataParallel, optim.DASO, plateau controller
+(reference model: heat/nn/tests/test_data_parallel.py,
+heat/optim/tests/test_dp_optimizer.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from harness import TestCase
+
+
+def make_classification(n=256, f=8, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((classes, f)).astype(np.float32) * 3
+    y = rng.integers(0, classes, n)
+    X = centers[y] + rng.standard_normal((n, f)).astype(np.float32) * 0.5
+    return X.astype(np.float32), y.astype(np.int32)
+
+
+class TestNNShim(TestCase):
+    def test_linen_fallback(self):
+        # reference pattern: ht.nn.<torch name>; here flax.linen names
+        self.assertTrue(hasattr(ht.nn, "Dense"))
+        self.assertTrue(callable(ht.nn.relu))
+        with pytest.raises(AttributeError):
+            ht.nn.DoesNotExist
+
+    def test_optim_shim(self):
+        sgd = ht.optim.SGD(0.1)
+        self.assertTrue(hasattr(sgd, "init"))
+        adam = ht.optim.Adam(1e-3)
+        self.assertTrue(hasattr(adam, "update"))
+        with pytest.raises(AttributeError):
+            ht.optim.NotAnOptimizer
+
+
+class TestDataParallel(TestCase):
+    def test_mlp_trains(self):
+        X, y = make_classification()
+        model = ht.nn.MLP(features=(32, 4))
+        dp = ht.nn.DataParallel(model, optimizer=ht.optim.Adam(5e-3))
+        dp.init(0, X[:8])
+        first = dp.train_step(X, y)
+        for _ in range(60):
+            last = dp.train_step(X, y)
+        self.assertLess(last, first * 0.5)
+        logits = dp(X)
+        acc = float(np.mean(np.argmax(np.asarray(logits), 1) == y))
+        self.assertGreater(acc, 0.8)
+        # state dict round trip
+        params = dp.state_dict()
+        dp2 = ht.nn.DataParallel(model, optimizer=ht.optim.Adam(5e-3))
+        dp2.init(0, X[:8])
+        dp2.load_state_dict(params)
+        np.testing.assert_allclose(
+            np.asarray(dp2(X[:4])), np.asarray(dp(X[:4])), rtol=1e-5
+        )
+        with pytest.raises(RuntimeError):
+            ht.nn.DataParallel(model).train_step(X, y)
+
+    def test_stateful_cnn(self):
+        # BatchNorm path: small ResNet on tiny images
+        rng = np.random.default_rng(1)
+        X = rng.standard_normal((16, 8, 8, 3)).astype(np.float32)
+        y = rng.integers(0, 2, 16).astype(np.int32)
+        model = ht.nn.ResNet(stage_sizes=(1,), num_classes=2, num_filters=8)
+        dp = ht.nn.DataParallel(model, optimizer=ht.optim.SGD(0.05))
+        dp.init(0, X[:2])
+        l0 = dp.train_step(X, y)
+        for _ in range(10):
+            l1 = dp.train_step(X, y)
+        self.assertLess(l1, l0 * 1.5)  # runs and stays finite
+        self.assertTrue(np.isfinite(l1))
+        out = dp(X)
+        self.assertEqual(np.asarray(out).shape, (16, 2))
+
+    def test_dndarray_input(self):
+        X, y = make_classification(n=64)
+        dp = ht.nn.DataParallel(ht.nn.MLP(features=(16, 4)))
+        dp.init(0, X[:4])
+        loss = dp.train_step(ht.array(X, split=0), ht.array(y, split=0))
+        self.assertTrue(np.isfinite(loss))
+
+
+class TestDASO(TestCase):
+    def test_daso_trains(self):
+        X, y = make_classification(n=256, seed=2)
+        daso = ht.optim.DASO(
+            local_optimizer=ht.optim.Adam(5e-3),
+            total_epochs=8,
+            warmup_epochs=1,
+            cooldown_epochs=1,
+            nodes=2,
+        )
+        self.assertEqual(daso.nodes, 2)
+        self.assertEqual(daso.ici_size, 4)
+        daso.add_model(ht.nn.MLP(features=(32, 4)), 0, X[:8])
+        batch = 64
+        first_epoch_loss = None
+        for epoch in range(8):
+            losses = []
+            for b in range(0, len(X), batch):
+                losses.append(daso.step(X[b : b + batch], y[b : b + batch]))
+            epoch_loss = float(np.mean(losses))
+            if first_epoch_loss is None:
+                first_epoch_loss = epoch_loss
+            daso.epoch_loss_logic(epoch_loss)
+        self.assertLess(epoch_loss, first_epoch_loss * 0.7)
+        logits = daso(X)
+        acc = float(np.mean(np.argmax(np.asarray(logits), 1) == y))
+        self.assertGreater(acc, 0.7)
+        # schedule engaged after warmup
+        self.assertGreaterEqual(daso.global_skip, 1)
+
+    def test_daso_validation(self):
+        with pytest.raises(TypeError):
+            ht.optim.DASO(ht.optim.SGD(0.1), total_epochs=1.5)
+        with pytest.raises(ValueError):
+            ht.optim.DASO(ht.optim.SGD(0.1), total_epochs=2, nodes=3)
+        with pytest.raises(ValueError):
+            ht.optim.DASO(ht.optim.SGD(0.1), total_epochs=2, warmup_epochs=-1)
+        daso = ht.optim.DASO(ht.optim.SGD(0.1), total_epochs=2)
+        with pytest.raises(RuntimeError):
+            daso.step(np.ones((4, 2)), np.zeros(4, np.int32))
+
+    def test_plateau_detector(self):
+        det = ht.optim.DetectMetricPlateau(patience=2, threshold=0.1, threshold_mode="rel")
+        # improving -> no plateau
+        self.assertFalse(det.test_if_improving(1.0))
+        self.assertFalse(det.test_if_improving(0.8))
+        self.assertFalse(det.test_if_improving(0.6))
+        # stagnating -> plateau after patience exceeded
+        self.assertFalse(det.test_if_improving(0.6))
+        self.assertFalse(det.test_if_improving(0.6))
+        self.assertTrue(det.test_if_improving(0.6))
+        # state round trip (reference optim/utils.py:72-108)
+        state = det.get_state()
+        det2 = ht.optim.DetectMetricPlateau()
+        det2.set_state(state)
+        self.assertEqual(det2.best, det.best)
+        det.reset()
+        self.assertEqual(det.num_bad_epochs, 0)
+        with pytest.raises(ValueError):
+            ht.optim.DetectMetricPlateau(mode="sideways")
+        with pytest.raises(ValueError):
+            ht.optim.DetectMetricPlateau(threshold_mode="diagonal")
+
+    def test_dp_optimizer_wrapper(self):
+        import jax.numpy as jnp
+
+        opt = ht.optim.DataParallelOptimizer(ht.optim.SGD(0.5))
+        params = {"w": jnp.ones(3)}
+        opt.init(params)
+        grads = {"w": jnp.ones(3)}
+        new = opt.step(grads, params)
+        np.testing.assert_allclose(np.asarray(new["w"]), 0.5)
+        opt.zero_grad()
+        with pytest.raises(TypeError):
+            ht.optim.DataParallelOptimizer(ht.optim.SGD(0.5), blocking="yes")

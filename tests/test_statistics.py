@@ -1,0 +1,247 @@
+"""Tests for statistics + random (reference model: heat/core/tests/
+test_statistics.py, test_random.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from harness import TestCase
+
+
+class TestReductions(TestCase):
+    def test_mean_var_std(self):
+        rng = np.random.default_rng(0)
+        a = rng.random((8, 6)).astype(np.float32) * 10
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            for axis in (None, 0, 1):
+                np.testing.assert_allclose(ht.mean(x, axis).numpy(), a.mean(axis), rtol=1e-4)
+                np.testing.assert_allclose(ht.var(x, axis).numpy(), a.var(axis), rtol=1e-3)
+                np.testing.assert_allclose(ht.std(x, axis).numpy(), a.std(axis), rtol=1e-3)
+            np.testing.assert_allclose(
+                ht.var(x, 0, ddof=1).numpy(), a.var(0, ddof=1), rtol=1e-3
+            )
+        # method form
+        self.assertAlmostEqual(float(x.mean()), a.mean(), places=3)
+        # int input promotes
+        self.assertIs(ht.mean(ht.arange(10, split=0)).dtype, ht.float32)
+        with pytest.raises(ValueError):
+            ht.var(x, ddof=2)
+        with pytest.raises(TypeError):
+            ht.var(x, ddof=1.0)
+
+    def test_max_min(self):
+        rng = np.random.default_rng(1)
+        a = rng.random((7, 5)).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            np.testing.assert_allclose(ht.max(x).numpy(), a.max())
+            np.testing.assert_allclose(ht.min(x, axis=0).numpy(), a.min(0))
+            np.testing.assert_allclose(x.max(axis=1).numpy(), a.max(1))
+        b = a[::-1].copy()
+        np.testing.assert_allclose(
+            ht.maximum(ht.array(a, split=0), ht.array(b, split=0)).numpy(), np.maximum(a, b)
+        )
+        np.testing.assert_allclose(
+            ht.minimum(ht.array(a), ht.array(b)).numpy(), np.minimum(a, b)
+        )
+
+    def test_argmax_argmin(self):
+        rng = np.random.default_rng(2)
+        a = rng.random((6, 9)).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            self.assertEqual(int(ht.argmax(x)), int(a.argmax()))
+            self.assertEqual(int(ht.argmin(x)), int(a.argmin()))
+            np.testing.assert_array_equal(ht.argmax(x, axis=0).numpy(), a.argmax(0))
+            np.testing.assert_array_equal(ht.argmin(x, axis=1).numpy(), a.argmin(1))
+        self.assertEqual(ht.argmax(ht.array(a, split=0), axis=0).split, None)
+        self.assertEqual(ht.argmax(ht.array(a, split=1), axis=0).split, 0)
+
+    def test_average(self):
+        a = np.arange(6.0, dtype=np.float32).reshape(3, 2)
+        w = np.array([0.25, 0.75], dtype=np.float32)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            np.testing.assert_allclose(ht.average(x).numpy(), np.average(a))
+            np.testing.assert_allclose(
+                ht.average(x, axis=1, weights=ht.array(w)).numpy(),
+                np.average(a, axis=1, weights=w),
+                rtol=1e-6,
+            )
+        r, s = ht.average(ht.array(a), axis=0, returned=True)
+        er, es = np.average(a, axis=0, returned=True)
+        np.testing.assert_allclose(r.numpy(), er)
+        np.testing.assert_allclose(s.numpy(), es)
+        with pytest.raises(TypeError):
+            ht.average(ht.array(a), weights=ht.array(w))
+        with pytest.raises(ValueError):
+            ht.average(ht.array(a), axis=0, weights=ht.array(w))
+
+    def test_median_percentile(self):
+        rng = np.random.default_rng(3)
+        a = rng.random((9, 4)).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            np.testing.assert_allclose(ht.median(x).numpy(), np.median(a), rtol=1e-5)
+            np.testing.assert_allclose(ht.median(x, axis=0).numpy(), np.median(a, 0), rtol=1e-5)
+            np.testing.assert_allclose(
+                ht.percentile(x, 30.0).numpy(), np.percentile(a, 30), rtol=1e-4
+            )
+            np.testing.assert_allclose(
+                ht.percentile(x, [10.0, 50.0, 90.0], axis=0).numpy(),
+                np.percentile(a, [10, 50, 90], axis=0),
+                rtol=1e-4,
+            )
+        with pytest.raises(ValueError):
+            ht.percentile(x, 50.0, interpolation="bad")
+
+    def test_moments(self):
+        from scipy import stats
+
+        rng = np.random.default_rng(4)
+        a = rng.standard_normal((50,)).astype(np.float32)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            self.assertAlmostEqual(
+                float(ht.skew(x, unbiased=False)), float(stats.skew(a, bias=True)), places=3
+            )
+            self.assertAlmostEqual(
+                float(ht.kurtosis(x, unbiased=False)),
+                float(stats.kurtosis(a, bias=True, fisher=True)),
+                places=3,
+            )
+            self.assertAlmostEqual(
+                float(ht.skew(x, unbiased=True)), float(stats.skew(a, bias=False)), places=3
+            )
+            self.assertAlmostEqual(
+                float(ht.kurtosis(x, unbiased=True)),
+                float(stats.kurtosis(a, bias=False, fisher=True)),
+                places=3,
+            )
+
+    def test_cov(self):
+        rng = np.random.default_rng(5)
+        a = rng.random((4, 20)).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            np.testing.assert_allclose(ht.cov(x).numpy(), np.cov(a), rtol=1e-3)
+            np.testing.assert_allclose(ht.cov(x, bias=True).numpy(), np.cov(a, bias=True), rtol=1e-3)
+        v = ht.array(a[0])
+        self.assertAlmostEqual(float(ht.cov(v)), float(np.cov(a[0])), places=4)
+        with pytest.raises(ValueError):
+            ht.cov(ht.ones((2, 2, 2)))
+
+
+class TestHistBin(TestCase):
+    def test_bincount(self):
+        a = np.array([0, 1, 1, 3, 2, 1, 7], dtype=np.int32)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            np.testing.assert_array_equal(ht.bincount(x).numpy(), np.bincount(a))
+            np.testing.assert_array_equal(
+                ht.bincount(x, minlength=10).numpy(), np.bincount(a, minlength=10)
+            )
+        w = np.arange(7, dtype=np.float32)
+        np.testing.assert_allclose(
+            ht.bincount(ht.array(a), weights=ht.array(w)).numpy(), np.bincount(a, weights=w)
+        )
+        with pytest.raises(TypeError):
+            ht.bincount(ht.array([1.5]))
+
+    def test_digitize_bucketize(self):
+        import torch
+
+        x = np.array([1.0, 2.5, 4.0, 6.0], dtype=np.float32)
+        bins = np.array([0.0, 2.0, 4.0, 5.0], dtype=np.float32)
+        for right in (False, True):
+            np.testing.assert_array_equal(
+                ht.digitize(ht.array(x), ht.array(bins), right=right).numpy(),
+                np.digitize(x, bins, right=right),
+            )
+            np.testing.assert_array_equal(
+                ht.bucketize(ht.array(x), ht.array(bins), right=right).numpy(),
+                torch.bucketize(torch.tensor(x), torch.tensor(bins), right=right).numpy(),
+            )
+
+    def test_histc_histogram(self):
+        import torch
+
+        rng = np.random.default_rng(6)
+        a = rng.random(50).astype(np.float32) * 10
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            np.testing.assert_allclose(
+                ht.histc(x, bins=10, min=0, max=10).numpy(),
+                torch.histc(torch.tensor(a), bins=10, min=0, max=10).numpy(),
+            )
+        h, e = ht.histogram(ht.array(a), bins=5)
+        eh, ee = np.histogram(a, bins=5)
+        np.testing.assert_array_equal(h.numpy(), eh)
+        np.testing.assert_allclose(e.numpy(), ee, rtol=1e-5)
+
+
+class TestRandom(TestCase):
+    def test_seed_reproducibility(self):
+        ht.random.seed(123)
+        a = ht.random.rand(10, 5, split=0)
+        ht.random.seed(123)
+        b = ht.random.rand(10, 5, split=0)
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+        # world-size independence: same values replicated vs split
+        ht.random.seed(123)
+        c = ht.random.rand(10, 5)
+        np.testing.assert_array_equal(a.numpy(), c.numpy())
+        # successive draws differ
+        d = ht.random.rand(10, 5)
+        self.assertFalse(np.array_equal(c.numpy(), d.numpy()))
+
+    def test_state(self):
+        ht.random.seed(7)
+        state = ht.random.get_state()
+        self.assertEqual(state[0], "Threefry")
+        self.assertEqual(state[1], 7)
+        a = ht.random.rand(4)
+        ht.random.set_state(("Threefry", 7, 0))
+        b = ht.random.rand(4)
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+        with pytest.raises(TypeError):
+            ht.random.set_state("bad")
+        with pytest.raises(ValueError):
+            ht.random.set_state(("Philox", 0, 0))
+
+    def test_distributions(self):
+        ht.random.seed(42)
+        u = ht.random.rand(1000, split=0)
+        self.assertTrue(0.0 <= float(u.min()) and float(u.max()) < 1.0)
+        self.assertAlmostEqual(float(u.mean()), 0.5, delta=0.05)
+        n = ht.random.randn(2000, split=0)
+        self.assertAlmostEqual(float(n.mean()), 0.0, delta=0.1)
+        self.assertAlmostEqual(float(n.std()), 1.0, delta=0.1)
+        m = ht.random.normal(5.0, 2.0, (2000,), split=0)
+        self.assertAlmostEqual(float(m.mean()), 5.0, delta=0.2)
+        r = ht.random.randint(0, 10, (500,), split=0)
+        self.assertTrue(0 <= int(r.min()) and int(r.max()) < 10)
+        self.assertIs(r.dtype, ht.int32)
+        un = ht.random.uniform(-2.0, 2.0, (100,))
+        self.assertTrue(-2.0 <= float(un.min()) and float(un.max()) < 2.0)
+        # int64 ranges beyond int32 (x64 is on in the test mesh)
+        big = ht.random.randint(0, 2**40, (100,), dtype=ht.int64)
+        self.assertGreater(int(big.max()), np.iinfo(np.int32).max)
+        with pytest.raises(ValueError):
+            ht.random.randint(5, 2)
+
+    def test_permutation(self):
+        ht.random.seed(0)
+        p = ht.random.permutation(10)
+        np.testing.assert_array_equal(np.sort(p.numpy()), np.arange(10))
+        x = ht.arange(8, split=0)
+        s = ht.random.permutation(x)
+        np.testing.assert_array_equal(np.sort(s.numpy()), np.arange(8))
+        rp = ht.random.randperm(6)
+        np.testing.assert_array_equal(np.sort(rp.numpy()), np.arange(6))
+        with pytest.raises(TypeError):
+            ht.random.permutation("abc")
+        with pytest.raises(TypeError):
+            ht.random.randperm(1.5)

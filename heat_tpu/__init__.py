@@ -29,6 +29,7 @@ from .core import (
     signal,
     statistics,
     stride_tricks,
+    tiling,
     trigonometrics,
     types,
     version,

@@ -31,6 +31,8 @@ from .statistics import *
 from .io import *
 from . import io
 from .manipulations import *
+from .tiling import *
+from . import tiling
 from .indexing import *
 from .signal import *
 from . import random

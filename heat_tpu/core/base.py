@@ -13,6 +13,7 @@ __all__ = [
     "RegressionMixin",
     "TransformMixin",
     "is_classifier",
+    "is_clusterer",
     "is_estimator",
     "is_regressor",
     "is_transformer",
@@ -140,3 +141,8 @@ def is_regressor(estimator) -> bool:
 def is_transformer(estimator) -> bool:
     """(reference base.py:239)"""
     return isinstance(estimator, TransformMixin)
+
+
+def is_clusterer(estimator) -> bool:
+    """(reference base.py:245)"""
+    return isinstance(estimator, ClusteringMixin)

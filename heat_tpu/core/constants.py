@@ -13,4 +13,26 @@ nan = NAN
 pi = PI
 e = E
 
-__all__ = ["e", "inf", "nan", "pi", "E", "INF", "NAN", "NINF", "PI"]
+# capitalized aliases (reference constants.py:7,26,38)
+Inf = INF
+Infty = INF
+Infinity = INF
+NaN = NAN
+Euler = E
+
+__all__ = [
+    "e",
+    "Euler",
+    "inf",
+    "Inf",
+    "Infty",
+    "Infinity",
+    "nan",
+    "NaN",
+    "pi",
+    "E",
+    "INF",
+    "NAN",
+    "NINF",
+    "PI",
+]

@@ -294,6 +294,37 @@ class DNDarray:
         """Global array view (halos are implicit in the global view)."""
         return self.__array
 
+    @property
+    def halo_prev(self) -> Optional[jax.Array]:
+        """Boundary slice a previous-neighbor shard would send (reference
+        dndarray.py:312-320). Derived from the global view: the trailing
+        ``halo_size`` slice along the split axis of the rank-0 shard."""
+        hs = getattr(self, "_DNDarray__halo_size", None)
+        if not hs or self.__split is None or self.__comm.size < 2:
+            return None
+        _, _, slices = self.__comm.chunk(self.__gshape, self.__split, rank=0)
+        stop = slices[self.__split].stop
+        idx = [slice(None)] * len(self.__gshape)
+        idx[self.__split] = slice(max(stop - hs, 0), stop)
+        return self.__array[tuple(idx)]
+
+    @property
+    def halo_next(self) -> Optional[jax.Array]:
+        """Boundary slice a next-neighbor shard would send (reference
+        dndarray.py:322-330); leading ``halo_size`` slice of the rank-1 shard."""
+        hs = getattr(self, "_DNDarray__halo_size", None)
+        if not hs or self.__split is None or self.__comm.size < 2:
+            return None
+        _, _, slices = self.__comm.chunk(self.__gshape, self.__split, rank=1)
+        start = slices[self.__split].start
+        idx = [slice(None)] * len(self.__gshape)
+        idx[self.__split] = slice(start, start + hs)
+        return self.__array[tuple(idx)]
+
+    def create_lshape_map(self, force_check: bool = False):
+        """Method form of ``lshape_map`` (reference dndarray.py:569-600)."""
+        return self.lshape_map
+
     # ------------------------------------------------------------------
     # conversions
     # ------------------------------------------------------------------

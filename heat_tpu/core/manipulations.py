@@ -59,6 +59,7 @@ __all__ = [
     "swapaxes",
     "tile",
     "topk",
+    "mpi_topk",
     "unique",
     "vsplit",
     "vstack",
@@ -521,3 +522,21 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis
     res = jnp.unique(a.larray, axis=axis)
     split = 0 if a.split is not None else None
     return _wrap(res, split, a)
+
+
+def mpi_topk(a, b, k: int, largest: bool = True):
+    """Merge two ``(values, indices)`` top-k partials into the combined top-k
+    — the pure-JAX equivalent of the reference's custom MPI merge op
+    (reference manipulations.py:3985-4028). Operates along the last axis."""
+    av, ai = a
+    bv, bi = b
+    vals = jnp.concatenate([av, bv], axis=-1)
+    inds = jnp.concatenate([ai, bi], axis=-1)
+    if k > vals.shape[-1]:
+        raise ValueError(f"k={k} out of range for combined partials of size {vals.shape[-1]}")
+    order = jnp.argsort(vals, axis=-1, descending=largest, stable=True)
+    order = jnp.take(order, jnp.arange(k), axis=-1)
+    return (
+        jnp.take_along_axis(vals, order, axis=-1),
+        jnp.take_along_axis(inds, order, axis=-1),
+    )

@@ -40,6 +40,8 @@ __all__ = [
     "maximum",
     "mean",
     "median",
+    "mpi_argmax",
+    "mpi_argmin",
     "min",
     "minimum",
     "percentile",
@@ -388,3 +390,23 @@ def var(x: DNDarray, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
         data = data.astype(types.promote_types(x.dtype, types.float32).jax_type())
     result = jnp.var(data, axis=axis, ddof=ddof)
     return _wrap(jnp.asarray(result), _reduced_split(x, axis), x)
+
+
+def mpi_argmax(a, b):
+    """Combiner merging two ``(values, indices)`` pairs to the elementwise max
+    and its global index — the pure-JAX equivalent of the reference's custom
+    MPI reduce op (reference statistics.py:1335-1370). Usable as the combine
+    fn of a ``lax.psum``-style tree or ``jax.lax.reduce`` over shards."""
+    av, ai = a
+    bv, bi = b
+    take_b = bv > av
+    return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+
+def mpi_argmin(a, b):
+    """Elementwise-min combiner over ``(values, indices)`` pairs
+    (reference statistics.py:1371-1405)."""
+    av, ai = a
+    bv, bi = b
+    take_b = bv < av
+    return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)

@@ -1,0 +1,296 @@
+"""Tile decompositions over distributed arrays (reference: heat/core/tiling.py).
+
+The reference builds two tile abstractions on top of per-rank ``torch``
+shards: ``SplitTiles`` (one tile per process along every axis, used by the
+arbitrary-axis ``resplit``, reference tiling.py:14-330) and
+``SquareDiagTiles`` (diagonal-aligned tiles for tile-QR, reference
+tiling.py:331-1257).
+
+TPU-native realization: a ``DNDarray`` is a *global* ``jax.Array``; a tile is
+a rectangular slice of the global index space, so both classes here are pure
+index arithmetic plus global-view slicing. No P2P choreography is needed —
+reading a tile that lives on another device is a sharded gather XLA lowers to
+the matching ICI collective, and writing one is a functional ``.at[]`` update.
+The public surface (properties, ``__getitem__``/``__setitem__``,
+``local_get``/``local_set``, ``match_tiles``) mirrors the reference so code
+written against it ports over.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import numpy as np
+
+from .dndarray import DNDarray
+
+__all__ = ["SplitTiles", "SquareDiagTiles"]
+
+
+def _axis_tile_sizes(length: int, n: int) -> np.ndarray:
+    """Block sizes when ``length`` is chunked into ``n`` contiguous blocks
+    (remainder on the lowest tiles — the reference chunk rule,
+    reference communication.py:193-203)."""
+    base, rem = divmod(length, n)
+    return np.array([base + (1 if i < rem else 0) for i in range(n)], dtype=np.int64)
+
+
+class SplitTiles:
+    """One tile per device along *every* axis (reference tiling.py:14-136).
+
+    ``tile_dimensions[d]`` holds the tile extents along axis ``d``;
+    ``tile_ends_g`` the inclusive global end indices; ``tile_locations`` maps
+    each tile to the device that owns it (determined by the split axis alone).
+    """
+
+    def __init__(self, arr: DNDarray):
+        self.__arr = arr
+        n = arr.comm.size
+        dims = max(arr.ndim, 1)
+        sizes = np.zeros((dims, n), dtype=np.int64)
+        for d in range(arr.ndim):
+            sizes[d] = _axis_tile_sizes(arr.gshape[d], n)
+        self.__tile_dimensions = sizes
+        self.__tile_ends_g = np.cumsum(sizes, axis=1) - 1
+        self.__tile_locations = self.set_tile_locations(arr.split, sizes, arr)
+
+    @staticmethod
+    def set_tile_locations(split: Optional[int], tile_dims: np.ndarray, arr: DNDarray) -> np.ndarray:
+        """Device-ownership grid: tiles are owned by the device holding their
+        slab of the split axis; replicated arrays live on device 0
+        (reference tiling.py:108-135)."""
+        n = arr.comm.size
+        shape = tuple(tile_dims.shape[1] for _ in range(max(arr.ndim, 1)))
+        locs = np.zeros(shape, dtype=np.int64)
+        if split is None or arr.ndim == 0:
+            return locs
+        idx = [None] * arr.ndim
+        idx[split] = slice(None)
+        locs += np.arange(n, dtype=np.int64)[tuple(idx)]
+        return locs
+
+    @property
+    def arr(self) -> DNDarray:
+        return self.__arr
+
+    @property
+    def lshape_map(self) -> np.ndarray:
+        return self.__arr.comm.lshape_map(self.__arr.gshape, self.__arr.split)
+
+    @property
+    def tile_locations(self) -> np.ndarray:
+        return self.__tile_locations
+
+    @property
+    def tile_ends_g(self) -> np.ndarray:
+        return self.__tile_ends_g
+
+    @property
+    def tile_dimensions(self) -> np.ndarray:
+        return self.__tile_dimensions
+
+    # ------------------------------------------------------------------
+    def __tile_slices(self, key) -> Tuple[slice, ...]:
+        """Translate a per-axis tile key into global index slices
+        (reference tiling.py:229-281)."""
+        if not isinstance(key, tuple):
+            key = (key,)
+        out = []
+        for d in range(self.__arr.ndim):
+            k = key[d] if d < len(key) else slice(None)
+            starts = np.concatenate(([0], self.__tile_ends_g[d][:-1] + 1))
+            ends = self.__tile_ends_g[d] + 1
+            if isinstance(k, slice):
+                idx = range(*k.indices(len(ends)))
+                if len(idx) == 0:
+                    out.append(slice(0, 0))
+                else:
+                    out.append(slice(int(starts[idx[0]]), int(ends[idx[-1]])))
+            else:
+                k = int(k)
+                out.append(slice(int(starts[k]), int(ends[k])))
+        return tuple(out)
+
+    def get_tile_size(self, key) -> Tuple[int, ...]:
+        """Shape of the tile(s) selected by ``key`` (reference tiling.py:282-330)."""
+        return tuple(s.stop - s.start for s in self.__tile_slices(key))
+
+    def __getitem__(self, key):
+        return self.__arr.larray[self.__tile_slices(key)]
+
+    def __setitem__(self, key, value) -> None:
+        self.__arr.larray = self.__arr.larray.at[self.__tile_slices(key)].set(value)
+
+
+class SquareDiagTiles:
+    """Diagonal-aligned tile decomposition for tile-QR (reference
+    tiling.py:331-724).
+
+    Tiles are square along the diagonal: row boundaries equal column
+    boundaries up to the diagonal's end, with ``tiles_per_proc`` tiles on
+    each device's slab of the split axis. The TPU QR path
+    (:mod:`heat_tpu.core.linalg.qr`) uses a TSQR reduction tree instead of
+    tile-CAQR, so this class serves the metadata/indexing API.
+    """
+
+    def __init__(self, arr: DNDarray, tiles_per_proc: int = 2):
+        if not isinstance(tiles_per_proc, int) or tiles_per_proc < 1:
+            raise ValueError(f"tiles_per_proc must be a positive int, got {tiles_per_proc}")
+        if arr.ndim != 2:
+            raise ValueError(f"arr must be 2D, got {arr.ndim}D")
+        self.__arr = arr
+        n = arr.comm.size
+        m, k = arr.gshape
+        split = arr.split if arr.split is not None else 0
+
+        # boundaries of the split axis: per-device slabs cut into
+        # tiles_per_proc tiles each
+        slab_sizes = _axis_tile_sizes(arr.gshape[split], n)
+        split_bounds: List[int] = [0]
+        for sz in slab_sizes:
+            for t in _axis_tile_sizes(int(sz), tiles_per_proc):
+                if t > 0:
+                    split_bounds.append(split_bounds[-1] + int(t))
+        # de-dup (empty slabs) and drop the leading 0
+        split_inds = sorted(set(split_bounds))[:-1]
+
+        # the non-split axis mirrors the split boundaries up to the diagonal
+        # end, then a single remainder tile (square-diagonal property)
+        diag_end = min(m, k)
+        other_len = arr.gshape[1 - split]
+        other_inds = [b for b in split_inds if b < diag_end and b < other_len]
+        if split == 0:
+            self.__row_inds, self.__col_inds = list(split_inds), list(other_inds)
+        else:
+            self.__row_inds, self.__col_inds = list(other_inds), list(split_inds)
+        self.__tiles_per_proc = tiles_per_proc
+        self.__split = split
+        self.__slab_starts = np.cumsum(np.concatenate(([0], slab_sizes)))[:-1]
+        self.__rebuild_maps()
+
+    def __rebuild_maps(self) -> None:
+        """(Re)derive tile_map and last_diagonal_process from the current
+        row/col boundaries — called at construction and after match_tiles."""
+        arr, split, n = self.__arr, self.__split, self.__arr.comm.size
+        m, k = arr.gshape
+        diag_end = min(m, k)
+
+        def owner(start: int) -> int:
+            # the device whose split-axis slab contains global index `start`
+            return int(np.searchsorted(self.__slab_starts, start, side="right") - 1)
+
+        row_bounds = self.__row_inds + [m]
+        col_bounds = self.__col_inds + [k]
+        self.__tile_map = np.zeros((len(self.__row_inds), len(self.__col_inds), 3), dtype=np.int64)
+        for i in range(len(self.__row_inds)):
+            for j in range(len(self.__col_inds)):
+                self.__tile_map[i, j, 0] = row_bounds[i]
+                self.__tile_map[i, j, 1] = col_bounds[j]
+                start = row_bounds[i] if split == 0 else col_bounds[j]
+                self.__tile_map[i, j, 2] = owner(start)
+
+        # last device owning part of the diagonal
+        self.__last_diag_pr = int(
+            np.searchsorted(self.__slab_starts, diag_end - 1, side="right") - 1
+        )
+
+    # ------------------------------------------------------------------
+    @property
+    def arr(self) -> DNDarray:
+        return self.__arr
+
+    @property
+    def col_indices(self) -> List[int]:
+        return list(self.__col_inds)
+
+    @property
+    def row_indices(self) -> List[int]:
+        return list(self.__row_inds)
+
+    @property
+    def lshape_map(self) -> np.ndarray:
+        return self.__arr.comm.lshape_map(self.__arr.gshape, self.__arr.split)
+
+    @property
+    def last_diagonal_process(self) -> int:
+        return self.__last_diag_pr
+
+    @property
+    def tile_columns(self) -> int:
+        return len(self.__col_inds)
+
+    @property
+    def tile_rows(self) -> int:
+        return len(self.__row_inds)
+
+    @property
+    def tile_columns_per_process(self) -> List[int]:
+        counts = np.bincount(self.__tile_map[0, :, 2], minlength=self.__arr.comm.size)
+        return [int(c) for c in counts] if self.__arr.split == 1 else [self.tile_columns] * self.__arr.comm.size
+
+    @property
+    def tile_rows_per_process(self) -> List[int]:
+        counts = np.bincount(self.__tile_map[:, 0, 2], minlength=self.__arr.comm.size)
+        return [int(c) for c in counts] if self.__arr.split in (0, None) else [self.tile_rows] * self.__arr.comm.size
+
+    @property
+    def tile_map(self) -> np.ndarray:
+        return self.__tile_map
+
+    @property
+    def tiles_per_proc(self) -> int:
+        return self.__tiles_per_proc
+
+    # ------------------------------------------------------------------
+    def get_start_stop(self, key) -> Tuple[int, int, int, int]:
+        """Global (row_start, row_stop, col_start, col_stop) of the tile(s)
+        at ``key`` (reference tiling.py:824-938 returns local offsets; the
+        global view needs no rank translation)."""
+        rs, cs = self.__key_to_slices(key)
+        return rs.start, rs.stop, cs.start, cs.stop
+
+    def __key_to_slices(self, key) -> Tuple[slice, slice]:
+        if not isinstance(key, tuple):
+            key = (key, slice(None))
+        row_bounds = self.__row_inds + [self.__arr.gshape[0]]
+        col_bounds = self.__col_inds + [self.__arr.gshape[1]]
+
+        def resolve(k, bounds):
+            n = len(bounds) - 1
+            if isinstance(k, slice):
+                idx = range(*k.indices(n))
+                if len(idx) == 0:
+                    return slice(0, 0)
+                return slice(bounds[idx[0]], bounds[idx[-1] + 1])
+            return slice(bounds[int(k)], bounds[int(k) + 1])
+
+        return resolve(key[0], row_bounds), resolve(key[1], col_bounds)
+
+    def __getitem__(self, key):
+        rs, cs = self.__key_to_slices(key)
+        return self.__arr.larray[rs, cs]
+
+    def __setitem__(self, key, value) -> None:
+        rs, cs = self.__key_to_slices(key)
+        self.__arr.larray = self.__arr.larray.at[rs, cs].set(value)
+
+    # the reference's local_* operate on the calling rank's shard; with a
+    # global array every tile is addressable, so local == global
+    def local_get(self, key):
+        """(reference tiling.py:939-958)"""
+        return self[key]
+
+    def local_set(self, key, value) -> None:
+        """(reference tiling.py:959-1021)"""
+        self[key] = value
+
+    def local_to_global(self, key, rank: Optional[int] = None):
+        """Identity under the global view (reference tiling.py:1022-1083)."""
+        return key
+
+    def match_tiles(self, tiles_to_match: "SquareDiagTiles") -> None:
+        """Align this decomposition's boundaries with another's so tile keys
+        agree between the two arrays (reference tiling.py:1084-1257)."""
+        self.__row_inds = [b for b in tiles_to_match.row_indices if b < self.__arr.gshape[0]]
+        self.__col_inds = [b for b in tiles_to_match.col_indices if b < self.__arr.gshape[1]]
+        self.__rebuild_maps()

@@ -52,7 +52,9 @@ __all__ = [
     "float_",
     "float64",
     "double",
+    "complex",
     "complex64",
+    "csingle",
     "cfloat",
     "complex128",
     "cdouble",
@@ -229,6 +231,9 @@ class complex128(complexfloating):
 
 
 cdouble = complex128
+csingle = complex64
+# reference types.py:367 names the abstract complex parent `complex`
+complex = complexfloating  # noqa: A001
 
 
 # ----------------------------------------------------------------------------

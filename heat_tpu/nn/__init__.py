@@ -7,7 +7,7 @@ library is flax.linen, re-exported here the same way: ``heat_tpu.nn.Dense``,
 ``DataParallel``/``DataParallelMultiGPU`` and the model zoo are native.
 """
 
-from . import models
+from . import functional, models
 from .data_parallel import DataParallel, DataParallelMultiGPU
 from .models import MLP, ResNet, ResNet18, ResNet50, SimpleCNN
 

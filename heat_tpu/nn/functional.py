@@ -1,0 +1,33 @@
+"""Functional NN interface (reference: heat/nn/functional.py).
+
+The reference module is a single dynamic shim forwarding attribute lookups to
+``torch.nn.functional`` (reference nn/functional.py:1-20, ``func_getattr``).
+The TPU-native backing functional library is ``jax.nn`` (activations,
+normalization, one-hot, attention helpers), with ``flax.linen`` as a fallback
+for layer-style callables, so ``heat_tpu.nn.functional.relu``,
+``...softmax``, ``...one_hot`` etc. all resolve.
+"""
+
+from __future__ import annotations
+
+import jax.nn as _jnn
+import flax.linen as _linen
+
+__all__ = ["func_getattr"]
+
+
+def func_getattr(name: str):
+    """Forward ``name`` to the backing functional library
+    (reference nn/functional.py — ``func_getattr`` forwards to
+    ``torch.nn.functional``)."""
+    try:
+        return getattr(_jnn, name)
+    except AttributeError:
+        try:
+            return getattr(_linen, name)
+        except AttributeError:
+            raise AttributeError(f"module 'heat_tpu.nn.functional' has no attribute {name!r}")
+
+
+def __getattr__(name: str):
+    return func_getattr(name)

@@ -18,7 +18,7 @@ import numpy as np
 from ...core import random as ht_random
 from ...core.dndarray import DNDarray
 
-__all__ = ["DataLoader", "Dataset", "dataset_shuffle", "dataset_ishuffle"]
+__all__ = ["DataLoader", "Dataset", "dataset_shuffle", "dataset_ishuffle", "dataset_irecv"]
 
 
 class Dataset:
@@ -128,3 +128,13 @@ def dataset_shuffle(dataset: Dataset, attrs=None) -> None:
 def dataset_ishuffle(dataset: Dataset, attrs=None) -> None:
     """Non-blocking shuffle hook (reference datatools.py:298-343)."""
     dataset.ishuffle_()
+
+
+def dataset_irecv(dataset: Dataset, attrs=None) -> None:
+    """Completion hook for the non-blocking shuffle: the reference waits on
+    the Irecv halves and splices them into the local shard
+    (reference datatools.py:344-392). JAX dispatch is already asynchronous —
+    the permuted arrays materialize when first consumed — so completing the
+    exchange is a device-side sync of the shuffled arrays."""
+    for a in dataset.arrays:
+        jax.block_until_ready(a.larray)

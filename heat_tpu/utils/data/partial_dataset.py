@@ -17,7 +17,40 @@ import numpy as np
 
 from ...core.dndarray import DNDarray
 
-__all__ = ["PartialH5Dataset", "PartialH5DataLoaderIter"]
+__all__ = ["PartialH5Dataset", "PartialH5DataLoaderIter", "queue_thread"]
+
+
+def queue_thread(q: "queue.Queue") -> threading.Thread:
+    """Spawn a daemon worker draining work items from ``q`` until a ``None``
+    sentinel (the reference's background load/convert thread pool primitive,
+    reference partial_dataset.py:20-31). An item is a bare callable or a
+    ``(fn, *args)`` tuple. ``task_done`` is guaranteed per item so ``q.join()``
+    cannot deadlock on a raising work function."""
+
+    def worker():
+        while True:
+            item = q.get()
+            try:
+                if item is None:
+                    return
+                if callable(item):
+                    item()
+                else:
+                    fn, *args = item
+                    # allow both (fn, (a, b)) and (fn, a, b)
+                    if len(args) == 1 and isinstance(args[0], tuple):
+                        args = args[0]
+                    fn(*args)
+            except Exception:  # noqa: BLE001 - background worker must survive
+                import traceback
+
+                traceback.print_exc()
+            finally:
+                q.task_done()
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    return t
 
 
 class PartialH5Dataset:

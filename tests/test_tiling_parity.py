@@ -1,0 +1,188 @@
+"""Tests for tiling (SplitTiles/SquareDiagTiles) and small parity additions
+(reference test model: heat/core/tests/test_tiling.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core.tiling import SplitTiles, SquareDiagTiles
+
+
+class TestSplitTiles:
+    def test_dimensions_cover_array(self):
+        a = ht.arange(40, dtype=ht.float32).reshape((8, 5)).resplit(0)
+        st = SplitTiles(a)
+        n = a.comm.size
+        assert st.tile_dimensions.shape == (2, n)
+        assert st.tile_dimensions[0].sum() == 8
+        assert st.tile_dimensions[1].sum() == 5
+        assert st.tile_ends_g[0][-1] == 7
+        assert st.tile_locations.shape == (n, n)
+
+    def test_locations_follow_split(self):
+        a = ht.zeros((8, 8), split=1)
+        st = SplitTiles(a)
+        n = a.comm.size
+        # every row of the location grid enumerates the devices along axis 1
+        assert np.array_equal(st.tile_locations[0], np.arange(n))
+        rep = SplitTiles(ht.zeros((8, 8)))
+        assert rep.tile_locations.sum() == 0
+
+    def test_get_set_roundtrip(self):
+        a = ht.arange(64, dtype=ht.float32).reshape((8, 8)).resplit(0)
+        st = SplitTiles(a)
+        t00 = np.asarray(st[0, 0])
+        assert t00.shape == st.get_tile_size((0, 0))
+        st[0, 0] = np.zeros_like(t00)
+        assert np.all(np.asarray(st[0, 0]) == 0)
+        # untouched region intact
+        full = a.numpy()
+        assert full[t00.shape[0]:, :].sum() > 0
+
+
+class TestSquareDiagTiles:
+    def test_square_diag_structure(self):
+        a = ht.random.randn(16, 8, split=0)
+        sq = SquareDiagTiles(a, tiles_per_proc=2)
+        assert sq.row_indices[0] == 0 and sq.col_indices[0] == 0
+        assert sq.tile_rows >= 1 and sq.tile_columns >= 1
+        assert sq.tile_map.shape == (sq.tile_rows, sq.tile_columns, 3)
+        assert 0 <= sq.last_diagonal_process < a.comm.size
+
+    def test_get_start_stop_and_local(self):
+        a = ht.arange(128, dtype=ht.float32).reshape((16, 8)).resplit(0)
+        sq = SquareDiagTiles(a, tiles_per_proc=1)
+        r0, r1, c0, c1 = sq.get_start_stop((0, 0))
+        expect = a.numpy()[r0:r1, c0:c1]
+        assert np.array_equal(np.asarray(sq.local_get((0, 0))), expect)
+        sq.local_set((0, 0), np.zeros_like(expect))
+        assert np.asarray(sq[0, 0]).sum() == 0
+
+    def test_uneven_slab_owners(self):
+        # 5 rows over n devices: slab sizes are uneven; every tile's owner
+        # must be the device whose slab contains the tile's start row
+        a = ht.random.randn(5, 5, split=0)
+        n = a.comm.size
+        sq = SquareDiagTiles(a, tiles_per_proc=2)
+        slab_sizes = [5 // n + (1 if i < 5 % n else 0) for i in range(n)]
+        starts = np.cumsum([0] + slab_sizes)[:-1]
+        for i, rstart in enumerate(sq.row_indices):
+            expect = int(np.searchsorted(starts, rstart, side="right") - 1)
+            assert sq.tile_map[i, 0, 2] == expect
+
+    def test_match_tiles(self):
+        a = SquareDiagTiles(ht.random.randn(16, 8, split=0), 2)
+        b = SquareDiagTiles(ht.random.randn(8, 8, split=0), 2)
+        b.match_tiles(a)
+        assert all(idx < 8 for idx in b.row_indices)
+        assert b.row_indices == [i for i in a.row_indices if i < 8]
+        # maps must be rebuilt to the matched decomposition
+        assert b.tile_map.shape[:2] == (b.tile_rows, b.tile_columns)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            SquareDiagTiles(ht.zeros((4, 4, 4), split=0), 1)
+        with pytest.raises(ValueError):
+            SquareDiagTiles(ht.zeros((4, 4), split=0), 0)
+
+
+class TestParityExtras:
+    def test_constant_aliases(self):
+        assert ht.Inf == ht.Infinity == ht.Infty == float("inf")
+        assert np.isnan(ht.NaN)
+        assert ht.Euler == ht.e
+
+    def test_type_aliases(self):
+        assert ht.csingle is ht.complex64
+        assert ht.types.complex is ht.complexfloating
+        assert ht.issubdtype(ht.complex64, ht.types.complex)
+
+    def test_remainder_alias(self):
+        a = ht.array([5, -5], split=0)
+        assert np.array_equal(ht.remainder(a, 3).numpy(), np.remainder([5, -5], 3))
+
+    def test_is_clusterer(self):
+        from heat_tpu.cluster import KMeans
+
+        assert ht.base.is_clusterer(KMeans())
+        assert not ht.base.is_clusterer(object())
+
+    def test_dndarray_halo_props(self):
+        a = ht.arange(16, dtype=ht.float32).resplit(0)
+        a.get_halo(2)
+        n = a.comm.size
+        if n > 1:
+            chunk = 16 // n
+            assert np.array_equal(np.asarray(a.halo_prev), a.numpy()[chunk - 2 : chunk])
+            assert np.array_equal(np.asarray(a.halo_next), a.numpy()[chunk : chunk + 2])
+        assert a.create_lshape_map().shape == (n, 1)
+
+    def test_mpi_combiners(self):
+        import jax.numpy as jnp
+
+        from heat_tpu.core.manipulations import mpi_topk
+        from heat_tpu.core.statistics import mpi_argmax, mpi_argmin
+
+        a = (jnp.array([1.0, 9.0]), jnp.array([0, 1]))
+        b = (jnp.array([5.0, 2.0]), jnp.array([2, 3]))
+        v, i = mpi_argmax(a, b)
+        assert v.tolist() == [5.0, 9.0] and i.tolist() == [2, 1]
+        v, i = mpi_argmin(a, b)
+        assert v.tolist() == [1.0, 2.0] and i.tolist() == [0, 3]
+        v, i = mpi_topk(a, b, k=2)
+        assert v.tolist() == [9.0, 5.0] and i.tolist() == [1, 2]
+
+    def test_nn_functional(self):
+        import jax.numpy as jnp
+
+        from heat_tpu.nn import functional as F
+
+        assert float(F.relu(jnp.array(-1.0))) == 0.0
+        assert F.func_getattr("softmax") is not None
+        with pytest.raises(AttributeError):
+            F.func_getattr("definitely_not_a_function")
+
+    def test_queue_thread(self):
+        import queue
+
+        from heat_tpu.utils.data.partial_dataset import queue_thread
+
+        q = queue.Queue()
+        out = []
+        t = queue_thread(q)
+        q.put((out.append, (1,)))
+        q.put((out.append, (2,)))
+        q.put(None)
+        q.join()
+        assert out == [1, 2]
+
+    def test_dataset_irecv(self):
+        from heat_tpu.utils.data import Dataset, dataset_irecv, dataset_ishuffle
+
+        ds = Dataset(ht.arange(32, dtype=ht.float32).resplit(0))
+        before = ds.arrays[0].numpy().copy()
+        dataset_ishuffle(ds)
+        dataset_irecv(ds)
+        after = ds.arrays[0].numpy()
+        assert sorted(after.tolist()) == sorted(before.tolist())
+
+    def test_tfrecord_idx(self, tmp_path):
+        import struct
+
+        from heat_tpu.utils.data._utils import dali_tfrecord2idx
+
+        train = tmp_path / "train"
+        val = tmp_path / "val"
+        for d in (train, val):
+            d.mkdir()
+            payload = b"x" * 10
+            with open(d / "shard0", "wb") as f:
+                for _ in range(3):
+                    f.write(struct.pack("<q", len(payload)))
+                    f.write(b"\0" * 4 + payload + b"\0" * 4)
+        tidx, vidx = tmp_path / "tidx", tmp_path / "vidx"
+        dali_tfrecord2idx(str(train) + "/", str(tidx) + "/", str(val) + "/", str(vidx) + "/")
+        lines = open(tidx / "shard0").read().splitlines()
+        assert len(lines) == 3
+        assert lines[0].split() == ["0", "26"]
+        assert lines[1].split() == ["26", "26"]

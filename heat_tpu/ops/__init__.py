@@ -1,0 +1,17 @@
+"""TPU pallas kernels for the hot ops.
+
+The reference framework's compute kernels live in libtorch (reference
+SURVEY.md vital stats: no native code in-repo, all kernels delegated). The
+TPU-native analog is XLA for everything fusion can handle, plus hand-written
+pallas kernels where the schedule matters. Current contents: the fused
+pairwise-distance tile kernel (:mod:`heat_tpu.ops.pairwise`) — an
+exact-numerics tiled alternative to the broadcast expression with a
+guaranteed O(n·m + (n+m)·f) HBM footprint (see its module docstring for the
+measured comparison against XLA's autofusion, which the default
+``spatial.cdist`` path uses).
+"""
+
+from . import pairwise
+from .pairwise import pairwise_distance
+
+__all__ = ["pairwise", "pairwise_distance"]

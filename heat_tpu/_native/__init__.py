@@ -1,0 +1,119 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The reference delegates its native layer to libtorch + MPI (reference
+SURVEY.md vital stats); the compute/communication side of this framework
+delegates to XLA the same way. The host-side data path, however, is our own:
+this package holds the C++ pieces, compiled on demand with the in-image g++
+toolchain and loaded through ctypes (no pybind11 in the image).
+
+Current components:
+- ``csv_reader.cpp`` — multithreaded byte-range CSV parser (the native
+  realization of reference heat/core/io.py:713-925's per-rank byte-range
+  scheme); used by :func:`heat_tpu.core.io.load_csv` with a pure-Python
+  fallback when the toolchain is unavailable.
+
+Set ``HEAT_TPU_NO_NATIVE=1`` to disable compilation and force the fallbacks.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["csv_scan", "csv_parse", "native_available"]
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "csv_reader.cpp")
+_SO = os.path.join(_DIR, "libheatcsv.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO, "-lpthread",
+    ]
+    try:
+        res = subprocess.run(cmd, capture_output=True, timeout=120)
+        return res.returncode == 0
+    except Exception:
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    """Compile (once, cached as a .so next to the source) and load."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("HEAT_TPU_NO_NATIVE"):
+            return None
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.csv_scan.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_longlong,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_longlong),
+        ]
+        lib.csv_scan.restype = ctypes.c_int
+        lib.csv_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_longlong, ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+        ]
+        lib.csv_parse.restype = ctypes.c_longlong
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    """Whether the native CSV reader could be compiled/loaded here."""
+    return _load() is not None
+
+
+def csv_scan(path: str, sep: str = ",", skip_lines: int = 0) -> Tuple[int, int]:
+    """(rows, cols) of the data region of a CSV file. Raises on failure."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native CSV reader unavailable")
+    rows = ctypes.c_longlong(0)
+    cols = ctypes.c_longlong(0)
+    rc = lib.csv_scan(
+        path.encode(), sep.encode()[:1], skip_lines, ctypes.byref(rows), ctypes.byref(cols)
+    )
+    if rc == -1:
+        raise IOError(f"cannot read {path}")
+    if rc == -2:
+        return 0, 0
+    return int(rows.value), int(cols.value)
+
+
+def csv_parse(
+    path: str, sep: str = ",", skip_lines: int = 0, n_threads: Optional[int] = None
+) -> np.ndarray:
+    """Parse a CSV file to a (rows, cols) float64 array with C++ threads."""
+    rows, cols = csv_scan(path, sep, skip_lines)
+    out = np.empty((rows, cols), dtype=np.float64)
+    if rows == 0:
+        return out
+    lib = _load()
+    assert lib is not None
+    nt = n_threads or min(os.cpu_count() or 1, 16)
+    done = lib.csv_parse(
+        path.encode(), sep.encode()[:1], skip_lines, rows, cols,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), nt,
+    )
+    if done != rows:
+        raise ValueError(f"malformed CSV {path}: parsed {done} of {rows} rows")
+    return out

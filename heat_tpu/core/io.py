@@ -198,16 +198,27 @@ def load_csv(
     if not isinstance(header_lines, int):
         raise TypeError(f"header_lines must be int, but was {type(header_lines)}")
     npdtype = np.dtype(types.canonical_heat_type(dtype).jax_type())
-    rows: List[List[float]] = []
-    with open(path, "r", encoding=encoding) as f:
-        for i, line in enumerate(f):
-            if i < header_lines:
-                continue
-            line = line.strip()
-            if not line:
-                continue
-            rows.append([float(v) for v in line.split(sep)])
-    arr = np.asarray(rows, dtype=npdtype)
+    arr = None
+    if len(sep) == 1 and encoding.lower().replace("-", "") in ("utf8", "ascii"):
+        # native path: multithreaded C++ byte-range parser (heat_tpu/_native)
+        try:
+            from .. import _native
+
+            if _native.native_available():
+                arr = _native.csv_parse(path, sep, header_lines).astype(npdtype, copy=False)
+        except Exception:
+            arr = None  # malformed for the strict parser or toolchain issue
+    if arr is None:
+        rows: List[List[float]] = []
+        with open(path, "r", encoding=encoding) as f:
+            for i, line in enumerate(f):
+                if i < header_lines:
+                    continue
+                line = line.strip()
+                if not line:
+                    continue
+                rows.append([float(v) for v in line.split(sep)])
+        arr = np.asarray(rows, dtype=npdtype)
     return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
 
 

@@ -166,9 +166,13 @@ def _fast_bincount(idx: jax.Array, length: int, weights: Optional[jax.Array] = N
     use_onehot = length <= _ONEHOT_BINCOUNT_MAX and jax.default_backend() in ("tpu", "axon")
     if not use_onehot:
         return jnp.bincount(idx, weights=weights, length=length)
-    oh = jax.nn.one_hot(idx, length, dtype=jnp.float32 if weights is None else weights.dtype)
     if weights is None:
-        return jnp.sum(oh, axis=0).astype(jnp.int64 if jax.config.read("jax_enable_x64") else jnp.int32)
+        # int32 accumulation keeps counts exact past f32's 2^24 integer range
+        oh = jax.nn.one_hot(idx, length, dtype=jnp.int32)
+        return jnp.sum(oh, axis=0).astype(
+            jnp.int64 if jax.config.read("jax_enable_x64") else jnp.int32
+        )
+    oh = jax.nn.one_hot(idx, length, dtype=weights.dtype)
     return weights @ oh  # (n,) @ (n, length): MXU
 
 

@@ -114,8 +114,17 @@ def pairwise_distance(
         y = x
     if p not in (1, 2):
         raise ValueError(f"p must be 1 or 2, got {p}")
+    if x.ndim != 2 or y.ndim != 2:
+        raise ValueError(f"x and y must be 2D, got {x.ndim}D and {y.ndim}D")
+    if x.shape[1] != y.shape[1]:
+        raise ValueError(f"feature counts differ: {x.shape[1]} != {y.shape[1]}")
     n, f = x.shape
     m = y.shape[0]
+    if f > _MAX_F:
+        raise ValueError(
+            f"f={f} exceeds the kernel's VMEM budget (max {_MAX_F}); "
+            "use the XLA broadcast expression for wide features"
+        )
     dtype = jnp.promote_types(x.dtype, jnp.float32)
     x = x.astype(dtype)
     y = y.astype(dtype)

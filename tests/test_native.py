@@ -1,0 +1,94 @@
+"""Tests for the native C++ CSV reader (heat_tpu/_native) and its io wiring."""
+
+import os
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import _native
+
+pytestmark = pytest.mark.skipif(
+    not _native.native_available(), reason="native toolchain unavailable"
+)
+
+
+class TestNativeCSV:
+    def test_scan_and_parse(self, tmp_path):
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal((200, 5))
+        p = tmp_path / "a.csv"
+        np.savetxt(p, a, delimiter=",", fmt="%.12g")
+        assert _native.csv_scan(str(p), ",") == (200, 5)
+        np.testing.assert_allclose(_native.csv_parse(str(p), ","), a, rtol=1e-10)
+
+    def test_header_blank_crlf(self, tmp_path):
+        p = tmp_path / "b.csv"
+        with open(p, "w", newline="") as f:
+            f.write("col1,col2\r\n\r\n1.5,2.5\r\n\r\n3,4\r\n")
+        out = _native.csv_parse(str(p), ",", skip_lines=1)
+        np.testing.assert_array_equal(out, [[1.5, 2.5], [3.0, 4.0]])
+
+    def test_no_trailing_newline_and_semicolon(self, tmp_path):
+        p = tmp_path / "c.csv"
+        with open(p, "w") as f:
+            f.write("1;2\n3;4")
+        np.testing.assert_array_equal(_native.csv_parse(str(p), ";"), [[1, 2], [3, 4]])
+
+    def test_special_values(self, tmp_path):
+        p = tmp_path / "d.csv"
+        with open(p, "w") as f:
+            f.write("inf,-inf,nan\n+1.5,2e3,-.5\n")
+        out = _native.csv_parse(str(p), ",")
+        assert np.isposinf(out[0, 0]) and np.isneginf(out[0, 1]) and np.isnan(out[0, 2])
+        np.testing.assert_array_equal(out[1], [1.5, 2000.0, -0.5])
+
+    def test_malformed_rejected(self, tmp_path):
+        short = tmp_path / "short.csv"
+        with open(short, "w") as f:
+            f.write("1,2,3\n4,5\n6,7,8\n")
+        with pytest.raises(ValueError):
+            _native.csv_parse(str(short), ",")
+        ragged_long = tmp_path / "long.csv"
+        with open(ragged_long, "w") as f:
+            f.write("1,2\n3,4,5\n")
+        with pytest.raises(ValueError):
+            _native.csv_parse(str(ragged_long), ",")
+        text = tmp_path / "text.csv"
+        with open(text, "w") as f:
+            f.write("1,abc\n")
+        with pytest.raises(ValueError):
+            _native.csv_parse(str(text), ",")
+
+    def test_missing_file(self):
+        with pytest.raises(IOError):
+            _native.csv_scan("/nonexistent/x.csv", ",")
+
+    def test_empty_file(self, tmp_path):
+        p = tmp_path / "e.csv"
+        p.write_text("")
+        assert _native.csv_scan(str(p), ",") == (0, 0)
+        assert _native.csv_parse(str(p), ",").shape == (0, 0)
+
+    def test_multithreaded_agrees(self, tmp_path):
+        rng = np.random.default_rng(1)
+        a = rng.standard_normal((999, 3))  # odd size: uneven chunks
+        p = tmp_path / "m.csv"
+        np.savetxt(p, a, delimiter=",", fmt="%.8g")
+        one = _native.csv_parse(str(p), ",", n_threads=1)
+        four = _native.csv_parse(str(p), ",", n_threads=4)
+        np.testing.assert_array_equal(one, four)
+
+
+class TestLoadCSVWiring:
+    def test_load_csv_uses_native_and_matches_fallback(self, tmp_path, monkeypatch):
+        rng = np.random.default_rng(2)
+        a = rng.standard_normal((64, 4)).astype(np.float32)
+        p = tmp_path / "w.csv"
+        np.savetxt(p, a, delimiter=",", fmt="%.8g", header="x,y,z,w", comments="")
+        native = ht.load_csv(str(p), header_lines=1, split=0)
+        # force the python fallback and compare
+        monkeypatch.setattr(_native, "native_available", lambda: False)
+        fallback = ht.load_csv(str(p), header_lines=1, split=0)
+        np.testing.assert_allclose(native.numpy(), fallback.numpy(), rtol=1e-6)
+        np.testing.assert_allclose(native.numpy(), a, rtol=1e-5)

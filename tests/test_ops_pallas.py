@@ -38,6 +38,12 @@ class TestPairwisePallas:
         assert not pallas_supported(10_000)
         with pytest.raises(ValueError):
             pairwise_distance(np.zeros((4, 4), np.float32), p=3)
+        with pytest.raises(ValueError):
+            pairwise_distance(np.zeros((4, 7), np.float32), np.zeros((4, 9), np.float32))
+        with pytest.raises(ValueError):
+            pairwise_distance(np.zeros((4, 600), np.float32))
+        with pytest.raises(ValueError):
+            pairwise_distance(np.zeros((4,), np.float32))
 
 
 class TestFastBincount:
@@ -51,6 +57,26 @@ class TestFastBincount:
         w = rng.random(5000).astype(np.float32)
         res = ht.bincount(ht.array(vals), weights=ht.array(w)).numpy()
         np.testing.assert_allclose(res, np.bincount(vals, weights=w), rtol=1e-4)
+
+    def test_onehot_branch_agrees_with_scatter(self, monkeypatch):
+        # the CPU test backend normally takes the scatter branch; force the
+        # one-hot branch so its numerics are covered too
+        import jax
+
+        from heat_tpu.core import statistics as st
+
+        rng = np.random.default_rng(7)
+        idx = np.asarray(rng.integers(0, 30, 4000), dtype=np.int32)
+        import jax.numpy as jnp
+
+        expect = np.bincount(idx, minlength=30)
+        with monkeypatch.context() as m:
+            m.setattr(jax, "default_backend", lambda: "tpu")
+            got = st._fast_bincount(jnp.asarray(idx), 30)
+            np.testing.assert_array_equal(np.asarray(got), expect)
+            w = rng.random(4000).astype(np.float32)
+            got_w = st._fast_bincount(jnp.asarray(idx), 30, jnp.asarray(w))
+            np.testing.assert_allclose(np.asarray(got_w), np.bincount(idx, weights=w), rtol=1e-4)
 
     def test_histogram_matches_numpy(self):
         import heat_tpu as ht

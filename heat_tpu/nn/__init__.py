@@ -5,9 +5,22 @@ The reference re-exports ``torch.nn`` attributes dynamically and adds
 library is flax.linen, re-exported here the same way: ``heat_tpu.nn.Dense``,
 ``heat_tpu.nn.Conv``, ``heat_tpu.nn.relu``... resolve to flax.linen, while
 ``DataParallel``/``DataParallelMultiGPU`` and the model zoo are native.
+
+Note: the explicit exports below take precedence over the flax.linen shim —
+in particular ``MultiHeadAttention`` and ``dot_product_attention`` are the
+native sequence-parallel implementations from :mod:`heat_tpu.nn.attention`
+(different signatures from flax's: no bias/dropout/decode arguments; the
+ring/ulysses backends take a ``comm``).
 """
 
-from . import functional, models
+from . import attention, functional, models
+from .attention import (
+    MultiHeadAttention,
+    dot_product_attention,
+    flash_attention,
+    ring_attention,
+    ulysses_attention,
+)
 from .data_parallel import DataParallel, DataParallelMultiGPU
 from .models import MLP, ResNet, ResNet18, ResNet50, SimpleCNN
 
@@ -22,6 +35,12 @@ __all__ = [
     "ResNet18",
     "ResNet50",
     "models",
+    "attention",
+    "MultiHeadAttention",
+    "dot_product_attention",
+    "flash_attention",
+    "ring_attention",
+    "ulysses_attention",
 ]
 
 

@@ -148,12 +148,15 @@ def _ring_dist(xl: jax.Array, yl: jax.Array, metric: Callable, comm) -> jax.Arra
     def kernel(xs, ys):
         rank = jax.lax.axis_index(axis)
 
-        def body(i, carry):
-            ys_cur, out = carry
+        def fold(i, ys_cur, out):
             # ys_cur currently holds the shard of device (rank + i) % p
             tile = metric(xs, ys_cur)
             col = ((rank + i.astype(rank.dtype)) % p) * m_block
-            out = jax.lax.dynamic_update_slice(out, tile, (jnp.zeros((), col.dtype), col))
+            return jax.lax.dynamic_update_slice(out, tile, (jnp.zeros((), col.dtype), col))
+
+        def body(i, carry):
+            ys_cur, out = carry
+            out = fold(i, ys_cur, out)
             # rotate: receive the next shard from the right neighbor
             ys_next = jax.lax.ppermute(
                 ys_cur, axis, [(j, (j - 1) % p) for j in range(p)]
@@ -163,8 +166,9 @@ def _ring_dist(xl: jax.Array, yl: jax.Array, metric: Callable, comm) -> jax.Arra
         out0 = jax.lax.pcast(
             jnp.zeros((xs.shape[0], m_block * p), dtype=xs.dtype), (axis,), to="varying"
         )
-        _, out = jax.lax.fori_loop(0, p, body, (ys, out0))
-        return out
+        # p-1 rotations; the last visiting shard is folded without re-sending it
+        ys_last, out = jax.lax.fori_loop(0, p - 1, body, (ys, out0))
+        return fold(jnp.asarray(p - 1), ys_last, out)
 
     fn = jax.jit(
         jax.shard_map(

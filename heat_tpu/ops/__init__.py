@@ -11,7 +11,8 @@ measured comparison against XLA's autofusion, which the default
 ``spatial.cdist`` path uses).
 """
 
-from . import pairwise
+from . import flash, pairwise
+from .flash import flash_attention_tpu
 from .pairwise import pairwise_distance
 
-__all__ = ["pairwise", "pairwise_distance"]
+__all__ = ["flash", "pairwise", "pairwise_distance", "flash_attention_tpu"]

@@ -1,0 +1,155 @@
+"""Hand-tiled pallas flash-attention kernel for TPU.
+
+The scan-based :func:`heat_tpu.nn.attention.flash_attention` leaves the tile
+schedule to XLA; this kernel owns it: the (q_block, k_block) tiling lives on
+the pallas grid, Q/K/V tiles are staged HBM→VMEM by BlockSpecs, the two
+matmuls per tile hit the MXU, and the online-softmax state (m, l, acc) stays
+in registers/VMEM across the k-loop. With ``causal=True`` the k-loop bound is
+computed from the query block's global offset, so tiles strictly above the
+diagonal are never read — a ~2x FLOP/traffic saving XLA's scan cannot express
+(its loop trip count is uniform).
+
+The reference framework has no attention; this kernel is the long-context
+hot-op analog of its densest compute path (the cdist tile kernel,
+reference spatial/distance.py:16-134 → heat_tpu/ops/pairwise.py).
+
+Layout: heads fold into the grid's leading axis ([B, H] → programs), head_dim
+is the lane axis padded to 128, sequence is the sublane axis in (128, D)
+tiles. K/V are presented per-program as the full (padded) sequence; VMEM
+holds S·D·4·2 bytes of K+V per program, and the ``pallas_attention_supported``
+gate caps that at 8 MB (S ≈ 8k at D=128) to leave headroom in the ~16 MB
+VMEM for Q/O tiles and double buffering.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["flash_attention_tpu", "pallas_attention_supported"]
+
+_LANE = 128
+_NEG_INF = -1e30  # large-negative instead of -inf: exp() underflows to 0 identically
+
+
+def pallas_attention_supported(seq_len: int, head_dim: int) -> bool:
+    """TPU backend present and K+V for one (batch, head) fit VMEM comfortably."""
+    try:
+        on_tpu = jax.default_backend() in ("tpu", "axon")
+    except Exception:  # pragma: no cover
+        return False
+    d_pad = max(_LANE, -(-head_dim // _LANE) * _LANE)
+    kv_bytes = 2 * seq_len * d_pad * 4
+    return on_tpu and kv_bytes <= 8 * 1024 * 1024
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_k, sk, nk):
+    """One (batch·head, q-block) program: stream k/v tiles, fold online softmax."""
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # (block_q, D)
+    q_idx0 = iq * block_q
+
+    if causal:
+        # highest key index any row of this q-block may see is q_idx0+block_q-1
+        # (all-int32 arithmetic: x64 mode would otherwise promote and trip lax.div)
+        one = jnp.int32(1)
+        nk_eff = jnp.minimum(
+            jnp.int32(nk),
+            (q_idx0 + jnp.int32(block_q) + jnp.int32(block_k) - one) // jnp.int32(block_k),
+        )
+    else:
+        nk_eff = jnp.int32(nk)
+
+    def body(jk, carry):
+        m, l, acc = carry
+        k0 = jk * block_k
+        kb = k_ref[0, pl.ds(k0, block_k), :].astype(jnp.float32)  # (block_k, D)
+        vb = v_ref[0, pl.ds(k0, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (block_q, block_k)
+        k_ids = k0 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        keep = k_ids < sk  # mask sequence padding
+        if causal:
+            q_ids = q_idx0 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            keep = keep & (q_ids >= k_ids)
+        s = jnp.where(keep, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=1))
+        p = jnp.exp(s - m_new[:, None])  # fully-masked rows: exp(-1e30+1e30)=1? no: see below
+        # rows with m_new == _NEG_INF are all-masked; zero their probabilities
+        p = jnp.where((m_new > _NEG_INF / 2)[:, None], p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = alpha * l + p.sum(axis=1)
+        acc = alpha[:, None] * acc + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l, acc
+
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    a0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, a0))
+    denom = jnp.where(l > 0, l, 1.0)
+    o_ref[0] = (acc / denom[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "block_q", "block_k", "interpret")
+)
+def flash_attention_tpu(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Pallas flash attention on [B, S, H, D] inputs (same contract as
+    :func:`heat_tpu.nn.attention.flash_attention`)."""
+    B, S, H, D = q.shape
+    sk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+
+    d_pad = max(_LANE, -(-D // _LANE) * _LANE)
+    sq_pad = -(-S // block_q) * block_q
+    sk_pad = -(-sk // block_k) * block_k
+
+    def to_bhsd(x, s_pad):
+        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(B * H, x.shape[1], D)
+        return jnp.pad(x, ((0, 0), (0, s_pad - x.shape[1]), (0, d_pad - D)))
+
+    qf, kf, vf = to_bhsd(q, sq_pad), to_bhsd(k, sk_pad), to_bhsd(v, sk_pad)
+    nq, nk = sq_pad // block_q, sk_pad // block_k
+
+    out = pl.pallas_call(
+        functools.partial(
+            _attn_kernel,
+            scale=scale,
+            causal=causal,
+            block_q=block_q,
+            block_k=block_k,
+            sk=sk,
+            nk=nk,
+        ),
+        grid=(B * H, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda bh, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, sk_pad, d_pad), lambda bh, iq: (bh, 0, 0)),
+            pl.BlockSpec((1, sk_pad, d_pad), lambda bh, iq: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d_pad), lambda bh, iq: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, sq_pad, d_pad), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+
+    out = out[:, :S, :D].reshape(B, H, S, D)
+    return jnp.transpose(out, (0, 2, 1, 3))

@@ -175,13 +175,44 @@ class DataParallel:
             )
         return float(loss)
 
+    # ------------------------------------------------------------------
+    # checkpoint / resume (no reference analog: the reference checkpoints
+    # data only, io.py:149-227 — model/optimizer resume is TPU-build new).
+    # state_dict/load_state_dict have the same full-trainer-state meaning
+    # here as on DASO.
+    # ------------------------------------------------------------------
     def state_dict(self):
-        """Parameter pytree (torch-API parity helper)."""
-        return self.params
+        """Full resumable state: params, model state, and optimizer state."""
+        return {
+            "params": self.params,
+            "state": self.state if self.state is not None else {},
+            "opt_state": self.opt_state,
+        }
 
-    def load_state_dict(self, params):
-        self.params = params
-        self.opt_state = self.optimizer.init(params)
+    def load_state_dict(self, sd) -> "DataParallel":
+        """Restore :meth:`state_dict` output. A bare params pytree (the
+        torch-parity shape) is also accepted — optimizer state then restarts."""
+        if isinstance(sd, dict) and "params" in sd and "opt_state" in sd:
+            self.params = sd["params"]
+            if self._stateful:
+                self.state = sd["state"]
+            self.opt_state = sd["opt_state"]
+        else:
+            self.params = sd
+            self.opt_state = self.optimizer.init(sd)
+        return self
+
+    def save(self, directory: str, step: int = 0, keep: int = 3) -> str:
+        """Write ``directory/ckpt_{step}.msgpack`` (atomic; keeps newest ``keep``)."""
+        from ..utils.checkpoint import save_checkpoint
+
+        return save_checkpoint(directory, self.state_dict(), step=step, keep=keep)
+
+    def restore(self, directory: str, step: Optional[int] = None) -> "DataParallel":
+        """Resume from a checkpoint written by :meth:`save` (newest by default)."""
+        from ..utils.checkpoint import load_checkpoint
+
+        return self.load_state_dict(load_checkpoint(directory, self.state_dict(), step=step))
 
 
 class DataParallelMultiGPU(DataParallel):

@@ -353,3 +353,53 @@ class DASO:
 
     def zero_grad(self) -> None:
         """No-op under functional gradients (reference dp_optimizer.py:816-833)."""
+
+    # ------------------------------------------------------------------
+    # checkpoint / resume (the reference exposes DetectMetricPlateau
+    # get_state/set_state but nothing serializes them, SURVEY.md §5; here the
+    # full trainer — params, optimizer, skip schedule, plateau controller —
+    # round-trips through heat_tpu.utils.checkpoint)
+    # ------------------------------------------------------------------
+    def state_dict(self):
+        """Full resumable state. Restoring requires the same ``nodes`` layout
+        (params carry the leading dcn-group axis)."""
+        return {
+            "params": self.params,
+            "state": self.state if self.state is not None else {},
+            "opt_state": self.opt_state,
+            "schedule": {
+                "epoch": self.epoch,
+                "current_batch": self.current_batch,
+                "global_skip": self.global_skip,
+                "local_skip": self.local_skip,
+                "batches_to_wait": self.batches_to_wait,
+            },
+            "stability": self.stability.get_state(),
+        }
+
+    def load_state_dict(self, sd) -> "DASO":
+        self.params = sd["params"]
+        if self._stateful:
+            self.state = sd["state"]
+        self.opt_state = sd["opt_state"]
+        sched = sd["schedule"]
+        self.epoch = int(sched["epoch"])
+        self.current_batch = int(sched["current_batch"])
+        self.global_skip = int(sched["global_skip"])
+        self.local_skip = int(sched["local_skip"])
+        self.batches_to_wait = int(sched["batches_to_wait"])
+        self.stability.set_state(sd["stability"])
+        self._place()  # re-establish the dcn shardings on this mesh
+        return self
+
+    def save(self, directory: str, step: int = 0, keep: int = 3) -> str:
+        """Write ``directory/ckpt_{step}.msgpack`` (atomic; keeps newest ``keep``)."""
+        from ..utils.checkpoint import save_checkpoint
+
+        return save_checkpoint(directory, self.state_dict(), step=step, keep=keep)
+
+    def restore(self, directory: str, step=None) -> "DASO":
+        """Resume from a checkpoint written by :meth:`save` (newest by default)."""
+        from ..utils.checkpoint import load_checkpoint
+
+        return self.load_state_dict(load_checkpoint(directory, self.state_dict(), step=step))

@@ -1,0 +1,113 @@
+"""Checkpoint / resume subsystem.
+
+The reference checkpoints *data* only (``ht.save``/``ht.load`` to
+HDF5/NetCDF/CSV, reference io.py:149-227); it has **no** model/optimizer
+checkpointing — DASO's ``DetectMetricPlateau`` exposes get_state/set_state
+dicts that nothing serializes (reference optim/utils.py:72-108, SURVEY.md §5).
+This module closes that gap for the TPU build:
+
+* :func:`save_checkpoint` / :func:`load_checkpoint` — any pytree of arrays to
+  a single msgpack file (flax.serialization), atomically (write tmp + rename),
+  with a retention policy (``keep``) and step-tagged filenames.
+* :func:`latest_step` — discover the newest step in a directory.
+* Trainer integration: ``DataParallel.state_dict/load_state_dict`` and
+  ``DASO.state_dict/load_state_dict`` (params, optimizer state, schedule
+  counters, plateau-controller state) round-trip through these files, so a
+  killed training run resumes exactly — the failure-recovery story MPI
+  fail-stop never had.
+
+Arrays come back as numpy; feed them to ``jax.device_put`` / the trainer's
+``load_state_dict`` which re-establishes shardings (single-controller JAX
+re-shards on first use, so a checkpoint written on one mesh shape restores
+onto another — elasticity the reference cannot express).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+from flax import serialization
+
+__all__ = [
+    "latest_step",
+    "load_checkpoint",
+    "save_checkpoint",
+]
+
+_FILE_RE = re.compile(r"^ckpt_(\d+)\.msgpack$")
+
+
+def _to_host(tree: Any) -> Any:
+    """Device arrays -> numpy (gathers sharded jax.Arrays to host)."""
+    return jax.tree.map(
+        lambda x: np.asarray(x) if hasattr(x, "dtype") or hasattr(x, "__array__") else x,
+        tree,
+    )
+
+
+def save_checkpoint(directory: str, tree: Any, step: int = 0, keep: int = 3) -> str:
+    """Serialize ``tree`` to ``directory/ckpt_{step}.msgpack`` atomically.
+
+    Older step files beyond the newest ``keep`` are deleted (``keep <= 0``
+    keeps everything). Returns the written path.
+    """
+    os.makedirs(directory, exist_ok=True)
+    payload = serialization.to_bytes(_to_host(tree))
+    path = os.path.join(directory, f"ckpt_{int(step)}.msgpack")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, path)  # atomic on POSIX: no torn checkpoints on crash
+    if keep > 0:
+        steps = _all_steps(directory)
+        for old in steps[:-keep]:
+            if old == int(step):
+                # never cull the checkpoint just written (e.g. a resumed run
+                # whose step counter restarted below existing step tags)
+                continue
+            try:
+                os.remove(os.path.join(directory, f"ckpt_{old}.msgpack"))
+            except OSError:  # pragma: no cover - concurrent cleanup
+                pass
+    return path
+
+
+def _all_steps(directory: str):
+    steps = []
+    try:
+        for name in os.listdir(directory):
+            m = _FILE_RE.match(name)
+            if m:
+                steps.append(int(m.group(1)))
+    except FileNotFoundError:
+        pass
+    return sorted(steps)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Newest checkpointed step in ``directory``, or None."""
+    steps = _all_steps(directory)
+    return steps[-1] if steps else None
+
+
+def load_checkpoint(directory: str, target: Any, step: Optional[int] = None) -> Any:
+    """Restore a checkpoint into the structure of ``target``.
+
+    ``target`` is a template pytree (e.g. a freshly-initialized state dict);
+    its leaves' shapes/dtypes validate the restore. ``step=None`` loads the
+    newest. Accepts a direct file path in ``directory`` too.
+    """
+    if os.path.isfile(directory):
+        path = directory
+    else:
+        if step is None:
+            step = latest_step(directory)
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints in {directory!r}")
+        path = os.path.join(directory, f"ckpt_{int(step)}.msgpack")
+    with open(path, "rb") as f:
+        return serialization.from_bytes(target, f.read())

@@ -1,0 +1,152 @@
+"""Tracing / profiling subsystem.
+
+The reference has no built-in profiling — its benchmarks hand-time with
+``time.perf_counter`` (reference benchmarks/kmeans/heat-cpu.py:22-26) and
+SURVEY.md §5 calls for ``jax.profiler`` traces as the first-class TPU
+replacement. This module provides:
+
+* :func:`trace` — context manager writing an XLA/TensorBoard trace directory
+  (open with ``tensorboard --logdir`` or xprof) covering everything the
+  enclosed code dispatches, including pallas kernels and ICI collectives.
+* :func:`annotate` — named region that shows up inside device traces
+  (``jax.profiler.TraceAnnotation``); usable as decorator or context manager.
+* :class:`Timer` / :func:`timed` — a process-local registry of wall-clock
+  timers that synchronize on device results (``block_until_ready``), so a
+  timed region measures compute, not dispatch.
+* :func:`report` — aggregate {name: {calls, total_s, mean_s, best_s}}.
+* :func:`device_memory_stats` — per-device live-bytes snapshot where the
+  backend exposes it (TPU does; forced-host CPU returns {}).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+__all__ = [
+    "Timer",
+    "annotate",
+    "device_memory_stats",
+    "report",
+    "reset",
+    "timed",
+    "trace",
+]
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_link: bool = False):
+    """Write a device+host profiler trace of the enclosed block to ``log_dir``."""
+    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named trace region: ``with annotate("lloyd_step"): ...`` or as a
+    decorator. Regions nest and appear on the device timeline."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class Timer:
+    """Wall-clock timer that blocks on device work before stopping.
+
+    >>> with Timer("assign"):           # records into the global registry
+    ...     out = step(x)               # result synced automatically if returned
+    """
+
+    _registry: Dict[str, Dict[str, Any]] = {}
+
+    def __init__(self, name: str, sync: bool = True):
+        self.name = name
+        self.sync = sync
+        self._start = None
+        self.elapsed = 0.0
+
+    def __enter__(self) -> "Timer":
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self.sync and exc == (None, None, None):
+            _sync_all_devices()
+        self.elapsed = time.perf_counter() - self._start
+        rec = self._registry.setdefault(
+            self.name, {"calls": 0, "total_s": 0.0, "best_s": float("inf")}
+        )
+        rec["calls"] += 1
+        rec["total_s"] += self.elapsed
+        rec["best_s"] = min(rec["best_s"], self.elapsed)
+
+
+def _sync_all_devices() -> None:
+    # Enqueue a trivial program on every local device and block on it. TPU and
+    # CPU execute per-device work in launch order, so this completes only
+    # after previously dispatched computation. (jax.effects_barrier is NOT a
+    # substitute: it waits on effect tokens only, not pure async dispatch.)
+    try:
+        for d in jax.local_devices():
+            jax.device_put(0, d).block_until_ready()
+    except Exception:  # pragma: no cover - backend-dependent
+        pass
+
+
+def timed(fn: Optional[Callable] = None, *, name: Optional[str] = None, sync: bool = True):
+    """Decorator recording each call of ``fn`` under ``name`` (default: its
+    qualname) and blocking on any returned jax arrays so device time counts."""
+
+    def wrap(f):
+        label = name or f.__qualname__
+
+        @functools.wraps(f)
+        def inner(*args, **kwargs):
+            with annotate(label), Timer(label, sync=False) as t:
+                out = f(*args, **kwargs)
+                if sync:
+                    jax.block_until_ready(out)
+            return out
+
+        return inner
+
+    return wrap(fn) if fn is not None else wrap
+
+
+def report() -> Dict[str, Dict[str, float]]:
+    """Aggregated timings: {name: {calls, total_s, mean_s, best_s}}."""
+    out = {}
+    for name, rec in Timer._registry.items():
+        out[name] = {
+            "calls": rec["calls"],
+            "total_s": rec["total_s"],
+            "mean_s": rec["total_s"] / rec["calls"],
+            "best_s": rec["best_s"],
+        }
+    return out
+
+
+def reset() -> None:
+    """Clear the timer registry."""
+    Timer._registry.clear()
+
+
+def device_memory_stats() -> Dict[str, Dict[str, int]]:
+    """Live/peak bytes per device, where the backend exposes memory_stats()."""
+    out: Dict[str, Dict[str, int]] = {}
+    for d in jax.devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:  # pragma: no cover - backend-dependent
+            stats = None
+        if stats:
+            out[str(d)] = {
+                k: int(v)
+                for k, v in stats.items()
+                if isinstance(v, (int, float)) and "bytes" in k
+            }
+    return out

@@ -1,0 +1,161 @@
+"""Checkpoint/resume and profiling subsystems (SURVEY.md §5: both absent in
+the reference — model/optimizer checkpointing and jax.profiler tracing are
+TPU-build additions)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.utils import checkpoint as ckpt
+from heat_tpu.utils import profiling
+
+
+class TestCheckpoint:
+    def test_roundtrip_pytree(self, tmp_path):
+        import jax.numpy as jnp
+
+        tree = {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.zeros(3), "meta": {"step": 7}}
+        path = ckpt.save_checkpoint(str(tmp_path), tree, step=7)
+        assert os.path.basename(path) == "ckpt_7.msgpack"
+        restored = ckpt.load_checkpoint(str(tmp_path), tree)
+        np.testing.assert_array_equal(np.asarray(restored["w"]), np.asarray(tree["w"]))
+        assert restored["meta"]["step"] == 7
+
+    def test_latest_and_retention(self, tmp_path):
+        tree = {"x": np.ones(2)}
+        for s in (1, 5, 3, 9, 11):
+            ckpt.save_checkpoint(str(tmp_path), tree, step=s, keep=3)
+        assert ckpt.latest_step(str(tmp_path)) == 11
+        kept = sorted(int(f.split("_")[1].split(".")[0]) for f in os.listdir(tmp_path))
+        assert kept == [5, 9, 11]
+
+    def test_retention_never_culls_just_written(self, tmp_path):
+        # a resumed run whose step counter restarted below existing tags
+        tree = {"x": np.ones(2)}
+        for s in (5, 9, 11):
+            ckpt.save_checkpoint(str(tmp_path), tree, step=s, keep=3)
+        path = ckpt.save_checkpoint(str(tmp_path), tree, step=3, keep=3)
+        assert os.path.exists(path)
+        restored = ckpt.load_checkpoint(path, tree)
+        np.testing.assert_array_equal(np.asarray(restored["x"]), tree["x"])
+
+    def test_load_missing_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            ckpt.load_checkpoint(str(tmp_path), {"x": np.ones(1)})
+
+    def test_atomicity_no_tmp_left(self, tmp_path):
+        ckpt.save_checkpoint(str(tmp_path), {"x": np.ones(4)}, step=0)
+        assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+
+    def test_dataparallel_resume(self, tmp_path):
+        import optax
+
+        comm = ht.get_comm()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((16, 6)).astype(np.float32)
+        y = rng.integers(0, 4, 16).astype(np.int32)
+
+        dp = ht.nn.DataParallel(
+            ht.nn.MLP(features=(8, 4)), comm=comm, optimizer=optax.adam(1e-2)
+        )
+        dp.init(0, x[:2])
+        for _ in range(3):
+            dp.train_step(x, y)
+        dp.save(str(tmp_path), step=3)
+
+        # fresh trainer, different init -> restore -> identical continued losses
+        dp2 = ht.nn.DataParallel(
+            ht.nn.MLP(features=(8, 4)), comm=comm, optimizer=optax.adam(1e-2)
+        )
+        dp2.init(1, x[:2])
+        dp2.restore(str(tmp_path))
+        l1 = dp.train_step(x, y)
+        l2 = dp2.train_step(x, y)
+        assert l1 == pytest.approx(l2, rel=1e-6)
+
+    def test_daso_resume_schedule_and_params(self, tmp_path):
+        comm = ht.get_comm()
+        daso = ht.optim.DASO(
+            local_optimizer=ht.optim.SGD(0.05),
+            total_epochs=4,
+            warmup_epochs=0,
+            cooldown_epochs=0,
+            comm=comm,
+            nodes=2,
+        )
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((16, 6)).astype(np.float32)
+        y = rng.integers(0, 4, 16).astype(np.int32)
+        daso.add_model(ht.nn.MLP(features=(8, 4)), 0, x[:2])
+        daso.step(x, y)
+        daso.global_skip = 2
+        daso.batches_to_wait = 1
+        daso.epoch = 2
+        daso.stability.test_if_improving(1.0)
+        daso.save(str(tmp_path), step=1)
+
+        daso2 = ht.optim.DASO(
+            local_optimizer=ht.optim.SGD(0.05),
+            total_epochs=4,
+            warmup_epochs=0,
+            cooldown_epochs=0,
+            comm=comm,
+            nodes=2,
+        )
+        daso2.add_model(ht.nn.MLP(features=(8, 4)), 3, x[:2])
+        daso2.restore(str(tmp_path))
+        assert daso2.global_skip == 2 and daso2.epoch == 2
+        assert daso2.stability.get_state() == daso.stability.get_state()
+        l1, l2 = daso.step(x, y), daso2.step(x, y)
+        assert l1 == pytest.approx(l2, rel=1e-5)
+
+
+class TestProfiling:
+    def test_timer_registry_and_report(self):
+        profiling.reset()
+        import jax.numpy as jnp
+
+        with profiling.Timer("mm"):
+            jnp.ones((64, 64)) @ jnp.ones((64, 64))
+        with profiling.Timer("mm"):
+            jnp.ones((64, 64)) @ jnp.ones((64, 64))
+        rep = profiling.report()
+        assert rep["mm"]["calls"] == 2
+        assert rep["mm"]["total_s"] >= rep["mm"]["best_s"] > 0
+        assert rep["mm"]["mean_s"] == pytest.approx(rep["mm"]["total_s"] / 2)
+        profiling.reset()
+        assert profiling.report() == {}
+
+    def test_timed_decorator_returns_value(self):
+        profiling.reset()
+
+        @profiling.timed(name="double")
+        def double(x):
+            return x * 2
+
+        import jax.numpy as jnp
+
+        out = double(jnp.arange(4))
+        np.testing.assert_array_equal(np.asarray(out), [0, 2, 4, 6])
+        assert profiling.report()["double"]["calls"] == 1
+
+    def test_annotate_nests(self):
+        with profiling.annotate("outer"):
+            with profiling.annotate("inner"):
+                pass  # must not raise, traced or not
+
+    def test_trace_writes_files(self, tmp_path):
+        import jax.numpy as jnp
+
+        with profiling.trace(str(tmp_path)):
+            (jnp.ones((32, 32)) @ jnp.ones((32, 32))).block_until_ready()
+        walked = [os.path.join(r, f) for r, _, fs in os.walk(tmp_path) for f in fs]
+        assert walked, "profiler trace produced no files"
+
+    def test_device_memory_stats_shape(self):
+        stats = profiling.device_memory_stats()
+        assert isinstance(stats, dict)
+        for v in stats.values():
+            assert all(isinstance(b, int) for b in v.values())

@@ -35,8 +35,9 @@ def _lloyd_run(data: jax.Array, centers: jax.Array, k: int, n_steps: int):
         centers, _, _, _ = carry
         return _lloyd_iter(data, centers, k)
 
+    acc = jnp.zeros((), data.dtype)
     out = jax.lax.fori_loop(
-        0, n_steps, body, (centers, jnp.zeros(data.shape[0], jnp.int32), jnp.float32(0), jnp.float32(0))
+        0, n_steps, body, (centers, jnp.zeros(data.shape[0], jnp.int32), acc, acc)
     )
     return out
 

@@ -413,7 +413,14 @@ class DNDarray:
         if isinstance(key, tuple):
             return tuple(DNDarray._unwrap_key(k) for k in key)
         if isinstance(key, list):
-            return [DNDarray._unwrap_key(k) for k in key]
+            # numpy fancy-index semantics: a list key is an array index
+            # (jax rejects bare sequences, jax#4564); empty lists must be
+            # integer-typed or jax rejects the float indexer
+            if not key:
+                return jnp.asarray([], dtype=jnp.int32)
+            return jnp.asarray([DNDarray._unwrap_key(k) for k in key])
+        if isinstance(key, np.ndarray):
+            return jnp.asarray(key)
         return key
 
     def _result_split(self, key) -> Optional[int]:
@@ -476,6 +483,9 @@ class DNDarray:
         jkey = DNDarray._unwrap_key(key)
         if isinstance(value, DNDarray):
             value = value.larray
+        # numpy setitem semantics: the value is cast to the destination dtype
+        if hasattr(value, "dtype") and value.dtype != self.__array.dtype:
+            value = jnp.asarray(value).astype(self.__array.dtype)
         new = self.__array.at[jkey].set(value)
         self.__array = _ensure_split(new, self.__split, self.__comm)
 

@@ -100,7 +100,9 @@ def lanczos(
 
             rng = _np.random.default_rng(i)
             vn = factories.array(
-                rng.standard_normal(n).astype(_np.float32), split=A.split, comm=A.comm
+                rng.standard_normal(n).astype(_np.dtype(v0.dtype.jax_type())),
+                split=A.split,
+                comm=A.comm,
             )
             # orthogonalize against V
             vi_loc = V.larray[:, :i]

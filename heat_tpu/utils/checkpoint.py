@@ -42,11 +42,22 @@ _FILE_RE = re.compile(r"^ckpt_(\d+)\.msgpack$")
 
 
 def _to_host(tree: Any) -> Any:
-    """Device arrays -> numpy (gathers sharded jax.Arrays to host)."""
-    return jax.tree.map(
-        lambda x: np.asarray(x) if hasattr(x, "dtype") or hasattr(x, "__array__") else x,
-        tree,
-    )
+    """Device arrays -> numpy (gathers sharded jax.Arrays to host).
+
+    Arrays spanning non-addressable devices (multi-host meshes) cannot be
+    read with ``np.asarray``; those are allgathered across processes first.
+    """
+
+    def to_np(x):
+        if not (hasattr(x, "dtype") or hasattr(x, "__array__")):
+            return x
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            x = multihost_utils.process_allgather(x, tiled=True)
+        return np.asarray(x)
+
+    return jax.tree.map(to_np, tree)
 
 
 def save_checkpoint(directory: str, tree: Any, step: int = 0, keep: int = 3) -> str:

@@ -85,14 +85,20 @@ class Timer:
         rec["best_s"] = min(rec["best_s"], self.elapsed)
 
 
+@functools.lru_cache(maxsize=None)
+def _sync_probe(device):
+    # A compiled no-op pinned to one device. Executable launches are ordered
+    # per device, so blocking on its output waits for all previously enqueued
+    # COMPUTE on that device — a device_put would ride the transfer stream and
+    # can complete while compute is still running. (jax.effects_barrier is NOT
+    # a substitute either: it waits on effect tokens, not async dispatch.)
+    return jax.jit(lambda: jax.numpy.zeros(()), device=device)
+
+
 def _sync_all_devices() -> None:
-    # Enqueue a trivial program on every local device and block on it. TPU and
-    # CPU execute per-device work in launch order, so this completes only
-    # after previously dispatched computation. (jax.effects_barrier is NOT a
-    # substitute: it waits on effect tokens only, not pure async dispatch.)
     try:
         for d in jax.local_devices():
-            jax.device_put(0, d).block_until_ready()
+            _sync_probe(d)().block_until_ready()
     except Exception:  # pragma: no cover - backend-dependent
         pass
 

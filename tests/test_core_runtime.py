@@ -205,6 +205,10 @@ class TestDNDarray(TestCase):
         x = ht.array(nx, split=0)
         idx = ht.array([0, 3, 5])
         self.assert_array_equal(x[idx], nx[[0, 3, 5]])
+        # bare python lists are fancy indices (numpy semantics, jax#4564)
+        self.assert_array_equal(x[[0, 3, 5]], nx[[0, 3, 5]])
+        self.assert_array_equal(x[[1, 5], [0, 2]], nx[[1, 5], [0, 2]])
+        self.assert_array_equal(x[np.array([2, 4])], nx[np.array([2, 4])])
 
     def test_setitem(self):
         nx = np.arange(16.0).reshape(4, 4)
@@ -218,6 +222,9 @@ class TestDNDarray(TestCase):
             expected[1:3, 1:3] = -1.0
             self.assert_array_equal(x, expected)
             self.assertEqual(x.split, split)
+            x[[0, 2]] = 7.0
+            expected[[0, 2]] = 7.0
+            self.assert_array_equal(x, expected)
 
     def test_fill_diagonal(self):
         x = ht.zeros((4, 4), split=0)

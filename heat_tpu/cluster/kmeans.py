@@ -29,11 +29,17 @@ def _lloyd_run(data: jax.Array, centers: jax.Array, k: int, n_steps: int):
     """``n_steps`` fused Lloyd iterations in ONE XLA program — amortizes the
     per-dispatch latency (the reference pays an MPI round per iteration; a
     remote-dispatch TPU pays one RPC per *program*, so fusing the loop is the
-    TPU-side analog of batching the collectives)."""
+    TPU-side analog of batching the collectives).
+
+    The |x|² term of the quadratic-expansion distance is loop-invariant: the
+    argmin over centers only sees −2x·cᵀ + |c|², and the inertia needs just
+    the scalar Σ|x|². Hoisting it saves an (n, f) square+reduce — pure HBM
+    bandwidth — per iteration."""
+    xsq_sum = jnp.sum(data * data)
 
     def body(i, carry):
         centers, _, _, _ = carry
-        return _lloyd_iter(data, centers, k)
+        return _lloyd_iter(data, centers, k, xsq_sum)
 
     acc = jnp.zeros((), data.dtype)
     out = jax.lax.fori_loop(
@@ -42,9 +48,12 @@ def _lloyd_run(data: jax.Array, centers: jax.Array, k: int, n_steps: int):
     return out
 
 
-def _lloyd_iter(data: jax.Array, centers: jax.Array, k: int):
-    d2 = _sq_dist(data, centers)  # (n, k)
-    labels = jnp.argmin(d2, axis=1).astype(jnp.int32)
+def _lloyd_iter(data: jax.Array, centers: jax.Array, k: int, xsq_sum=None):
+    if xsq_sum is None:
+        xsq_sum = jnp.sum(data * data)
+    # score = d² − |x|² (row-constant offset): same argmin, cheaper to form
+    score = jnp.sum(centers * centers, axis=1) - 2.0 * (data @ centers.T)  # (n, k)
+    labels = jnp.argmin(score, axis=1).astype(jnp.int32)
     onehot = jax.nn.one_hot(labels, k, dtype=data.dtype)  # (n, k)
     counts = jnp.sum(onehot, axis=0)  # (k,)
     sums = onehot.T @ data  # (k, f) — MXU; psum over the sharded rows
@@ -53,8 +62,8 @@ def _lloyd_iter(data: jax.Array, centers: jax.Array, k: int):
     )
     # labels are the argmin, so the assigned distance is the row minimum —
     # a fused reduction instead of a gather (take_along_axis is ~100x slower
-    # than the min on TPU for this shape)
-    inertia = jnp.sum(jnp.min(d2, axis=1))
+    # than the min on TPU for this shape); adding Σ|x|² restores true d²
+    inertia = jnp.maximum(jnp.sum(jnp.min(score, axis=1)) + xsq_sum, 0.0)
     shift = jnp.sum((new_centers - centers) ** 2)
     return new_centers, labels, inertia, shift
 

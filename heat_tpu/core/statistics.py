@@ -346,7 +346,7 @@ def maximum(x1: DNDarray, x2: DNDarray, out=None) -> DNDarray:
     return _binary_op(jnp.maximum, x1, x2, out=out)
 
 
-def mean(x: DNDarray, axis=None) -> DNDarray:
+def mean(x: DNDarray, axis=None, keepdims: bool = False) -> DNDarray:
     """Arithmetic mean (reference statistics.py:941-1007: local torch.mean +
     Allreduce of (mu, n) pairs with sequential merging; one sharded jnp.mean
     here)."""
@@ -355,8 +355,8 @@ def mean(x: DNDarray, axis=None) -> DNDarray:
     data = x.larray
     if types.heat_type_is_exact(x.dtype):
         data = data.astype(types.promote_types(x.dtype, types.float32).jax_type())
-    result = jnp.mean(data, axis=axis)
-    return _wrap(result, _reduced_split(x, axis), x)
+    result = jnp.mean(data, axis=axis, keepdims=keepdims)
+    return _wrap(result, _reduced_split(x, axis, keepdims), x)
 
 
 def median(x: DNDarray, axis: Optional[int] = None, keepdims: bool = False) -> DNDarray:
@@ -427,12 +427,13 @@ def var(x: DNDarray, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
         raise ValueError("Only ddof=0 or ddof=1 is supported")
     if kwargs.get("bessel") is not None:
         ddof = 1 if kwargs["bessel"] else 0
+    keepdims = bool(kwargs.get("keepdims", False))
     axis = sanitize_axis(x.shape, axis)
     data = x.larray
     if types.heat_type_is_exact(x.dtype):
         data = data.astype(types.promote_types(x.dtype, types.float32).jax_type())
-    result = jnp.var(data, axis=axis, ddof=ddof)
-    return _wrap(jnp.asarray(result), _reduced_split(x, axis), x)
+    result = jnp.var(data, axis=axis, ddof=ddof, keepdims=keepdims)
+    return _wrap(jnp.asarray(result), _reduced_split(x, axis, keepdims), x)
 
 
 def mpi_argmax(a, b):

@@ -335,11 +335,48 @@ def isreal(x):
     return factories.ones(x.shape, dtype=bool, split=x.split, device=x.device, comm=x.comm)
 
 
+# Promotion scan order and cast rule (reference types.py:604-666). The
+# reference's "intuitive" rule is numpy's "safe" casting plus the two
+# torch-style exceptions int32->float32 and int32->complex64 — which is what
+# makes ht.promote_types(int32, float32) == float32 (reference types.py:855)
+# where numpy would say float64.
+_PROMOTE_ORDER = [
+    bool_,
+    uint8,
+    int8,
+    int16,
+    int32,
+    int64,
+    float32,
+    float64,
+    complex64,
+    complex128,
+]
+
+
+def _intuitive_can_cast(src: np.dtype, dst: np.dtype) -> builtins.bool:
+    if src == np.dtype(np.int32) and dst in (np.dtype(np.float32), np.dtype(np.complex64)):
+        return True
+    return np.can_cast(src, dst, casting="safe")
+
+
 def promote_types(type1, type2) -> type:
-    """Smallest type safely holding both (reference heat/core/types.py:836)."""
-    t1 = canonical_heat_type(type1)
-    t2 = canonical_heat_type(type2)
-    return canonical_heat_type(jnp.promote_types(t1.jax_type(), t2.jax_type()))
+    """Smallest type in the reference's scan order that both inputs cast to
+    under the "intuitive" rule (reference heat/core/types.py:755-761, 836).
+
+    float16/bfloat16 (TPU extensions; absent from the reference's table)
+    delegate to jax's promotion, which handles them natively."""
+    h1 = canonical_heat_type(type1)
+    h2 = canonical_heat_type(type2)
+    if float16 in (h1, h2) or bfloat16 in (h1, h2):
+        return canonical_heat_type(jnp.promote_types(h1.jax_type(), h2.jax_type()))
+    t1 = np.dtype(h1.char())
+    t2 = np.dtype(h2.char())
+    for target in _PROMOTE_ORDER:
+        td = np.dtype(target.char())
+        if _intuitive_can_cast(t1, td) and _intuitive_can_cast(t2, td):
+            return target
+    raise TypeError(f"no promotion for {type1}, {type2}")
 
 
 def result_type(*operands) -> type:
@@ -370,7 +407,13 @@ def result_type(*operands) -> type:
         else:
             dtypes.append(jnp.result_type(op))
     if dtypes:
-        res = jnp.result_type(*dtypes) if len(dtypes) > 1 else np.dtype(dtypes[0])
+        if len(dtypes) > 1:
+            acc = canonical_heat_type(dtypes[0])
+            for d in dtypes[1:]:
+                acc = promote_types(acc, canonical_heat_type(d))
+            res = np.dtype(acc.char()) if acc not in (bfloat16,) else acc.jax_type()
+        else:
+            res = np.dtype(dtypes[0])
         for kind in scalar_kinds:
             if kind == "complex":
                 res = jnp.promote_types(res, jnp.complex64)
@@ -392,18 +435,23 @@ def result_type(*operands) -> type:
 def can_cast(from_, to, casting: str = "intuitive") -> builtins.bool:
     """Casting feasibility check (reference heat/core/types.py:430).
 
-    The reference defines an extra ``"intuitive"`` rule = ``"same_kind"`` plus
-    allowing int64->float32 style value-preserving-ish casts; numpy's
-    ``same_kind`` already permits those, so intuitive maps to same_kind here.
+    The reference's ``"intuitive"`` rule (its default, types.py:636-649) is
+    numpy's ``"safe"`` plus the torch-style int32->float32 and
+    int32->complex64 casts.
     """
-    if casting == "intuitive":
-        casting = "same_kind"
     if isinstance(from_, type) and issubclass(from_, datatype):
         from_ = from_.jax_type()
-    elif hasattr(from_, "dtype") and hasattr(from_, "split"):
+    elif hasattr(from_, "dtype"):
+        # DNDarrays, numpy/jax arrays, scalars with a dtype: cast by dtype
         from_ = canonical_heat_type(from_.dtype).jax_type()
+    elif isinstance(from_, (builtins.bool, builtins.int, builtins.float, builtins.complex)):
+        # value-based scalar rule (reference types.py:707-710 examples):
+        # can_cast(1, float64) is True, can_cast(2.0e200, "u1") is False
+        from_ = np.min_scalar_type(from_)
     if isinstance(to, type) and issubclass(to, datatype):
         to = to.jax_type()
+    if casting == "intuitive":
+        return _intuitive_can_cast(np.dtype(from_), np.dtype(to))
     return np.can_cast(from_, np.dtype(to), casting=casting)
 
 

@@ -1,0 +1,208 @@
+"""Edge-behavior sweeps modeled on the reference's heavy test matrices:
+3-D split sweeps, keepdims, out/where kwargs, uneven (non-divisible) shapes,
+negative strides, the reference's promotion table (torch-like: int32+float32
+-> float32, reference types.py:855 docstring), and concat/stack sweeps.
+"""
+
+import numpy as np
+
+import heat_tpu as ht
+from harness import TestCase
+
+rng = np.random.default_rng(3)
+X3 = rng.standard_normal((6, 8, 10))
+
+
+class TestSplitSweeps3D(TestCase):
+    def test_reductions_3d(self):
+        for split in (None, 0, 1, 2):
+            a = ht.array(X3, split=split)
+            for ax in (None, 0, 1, 2, (0, 2)):
+                np.testing.assert_allclose(
+                    ht.sum(a, axis=ax).numpy(), X3.sum(axis=ax), atol=1e-8
+                )
+                np.testing.assert_allclose(
+                    ht.mean(a, axis=ax).numpy(), X3.mean(axis=ax), atol=1e-8
+                )
+
+    def test_binary_3d(self):
+        for split in (None, 0, 1, 2):
+            a = ht.array(X3, split=split)
+            b = ht.array(X3, split=split)
+            self.assert_array_equal(a * b, X3 * X3)
+
+    def test_argmax_max_3d(self):
+        for split in (None, 0, 1, 2):
+            a = ht.array(X3, split=split)
+            for ax in (0, 1, 2):
+                np.testing.assert_array_equal(ht.argmax(a, axis=ax).numpy(), X3.argmax(ax))
+                np.testing.assert_allclose(ht.max(a, axis=ax).numpy(), X3.max(ax))
+
+    def test_concat_stack_3d(self):
+        for split in (None, 0, 1, 2):
+            a = ht.array(X3, split=split)
+            b = ht.array(X3, split=split)
+            for ax in (0, 1, 2):
+                np.testing.assert_allclose(
+                    ht.concatenate([a, b], axis=ax).numpy(), np.concatenate([X3, X3], ax)
+                )
+                np.testing.assert_allclose(
+                    ht.stack([a, b], axis=ax).numpy(), np.stack([X3, X3], ax)
+                )
+
+
+class TestKeepdims(TestCase):
+    def test_keepdims(self):
+        a = ht.array(X3, split=1)
+        np.testing.assert_allclose(
+            ht.sum(a, axis=1, keepdims=True).numpy(), X3.sum(1, keepdims=True), atol=1e-8
+        )
+        np.testing.assert_allclose(
+            ht.mean(a, axis=0, keepdims=True).numpy(), X3.mean(0, keepdims=True), atol=1e-8
+        )
+        np.testing.assert_allclose(
+            ht.var(a, axis=0, keepdims=True).numpy(), X3.var(0, keepdims=True), atol=1e-8
+        )
+        np.testing.assert_allclose(
+            ht.std(a, axis=2, keepdims=True).numpy(), X3.std(2, keepdims=True), atol=1e-8
+        )
+        # split follows the kept dimension
+        self.assertEqual(ht.sum(a, axis=0, keepdims=True).split, 1)
+
+
+class TestOutWhere(TestCase):
+    def test_out_kwarg(self):
+        a = ht.array(X3, split=0)
+        out = ht.empty_like(a)
+        r = ht.add(a, a, out=out)
+        self.assertIs(r, out)
+        np.testing.assert_allclose(out.numpy(), 2 * X3, atol=1e-10)
+
+    def test_where_kwarg(self):
+        a = ht.array(X3, split=0)
+        w = X3 > 0
+        r = ht.add(a, a, where=ht.array(w, split=0), out=ht.zeros_like(a))
+        np.testing.assert_allclose(r.numpy(), np.where(w, 2 * X3, 0), atol=1e-10)
+
+
+class TestUnevenShapes(TestCase):
+    """13 and 7 do not divide the 8-device mesh: the pad/WSC fallback path."""
+
+    def test_uneven_ops(self):
+        y = rng.standard_normal((13, 7))
+        for split in (None, 0, 1):
+            a = ht.array(y, split=split)
+            np.testing.assert_allclose(ht.sum(a, axis=0).numpy(), y.sum(0), atol=1e-8)
+            np.testing.assert_allclose(ht.sort(a, axis=0)[0].numpy(), np.sort(y, 0))
+            self.assert_array_equal(a + a, 2 * y)
+
+    def test_uneven_matmul(self):
+        y = rng.standard_normal((13, 7))
+        for split in (0, 1):
+            a = ht.array(y, split=split)
+            np.testing.assert_allclose(ht.matmul(a, a.T).numpy(), y @ y.T, atol=1e-8)
+
+
+class TestStrides(TestCase):
+    def test_negative_strides(self):
+        a = ht.array(X3, split=0)
+        np.testing.assert_allclose(a[::-1].numpy(), X3[::-1])
+        np.testing.assert_allclose(a[:, ::-2].numpy(), X3[:, ::-2])
+        np.testing.assert_allclose(a[..., ::-1].numpy(), X3[..., ::-1])
+
+
+class TestPromotionTable(TestCase):
+    def test_reference_promotions(self):
+        # the reference promotes like torch, NOT numpy: int32+float32->float32
+        # (reference types.py:853-859 docstring examples)
+        cases = [
+            (np.uint8, np.uint8, np.uint8),
+            (np.int32, np.float32, np.float32),
+            (np.int64, np.float32, np.float64),
+            (np.float32, np.float64, np.float64),
+            (np.int8, np.int32, np.int32),
+        ]
+        for d1, d2, expect in cases:
+            a = ht.array(np.ones(4, d1))
+            b = ht.array(np.ones(4, d2))
+            got = np.dtype((a + b).numpy().dtype)
+            self.assertEqual(got, np.dtype(expect), f"{d1} + {d2}")
+        self.assertEqual(ht.promote_types(ht.int32, ht.float32), ht.float32)
+
+    def test_promote_types_parity(self):
+        self.assertEqual(ht.promote_types(ht.uint8, ht.uint8), ht.uint8)
+        self.assertEqual(ht.promote_types("i8", "f4"), ht.float64)
+
+
+class TestLinalgExtras(TestCase):
+    def test_outer_cross(self):
+        u = rng.standard_normal(11)
+        v = rng.standard_normal(13)
+        np.testing.assert_allclose(
+            ht.linalg.outer(ht.array(u, split=0), ht.array(v, split=0)).numpy(),
+            np.outer(u, v),
+            atol=1e-10,
+        )
+        c1 = rng.standard_normal((5, 3))
+        c2 = rng.standard_normal((5, 3))
+        np.testing.assert_allclose(
+            ht.cross(ht.array(c1, split=0), ht.array(c2, split=0)).numpy(),
+            np.cross(c1, c2),
+            atol=1e-10,
+        )
+
+
+class TestEstimatorDtypes(TestCase):
+    """float64 paths through the estimators (x64 is enabled in conftest)."""
+
+    def test_cluster_f64(self):
+        from heat_tpu.cluster import KMeans, Spectral
+
+        X = np.concatenate(
+            [rng.standard_normal((40, 2)) + 5, rng.standard_normal((40, 2)) - 5]
+        )
+        hX = ht.array(X, split=0)  # float64
+        km = KMeans(n_clusters=2).fit(hX)
+        self.assertEqual(len(set(km.predict(hX).numpy().ravel().tolist())), 2)
+        lab = Spectral(n_clusters=2, gamma=0.1).fit_predict(hX).numpy().ravel()
+        self.assertEqual(len(set(lab.tolist())), 2)
+
+    def test_kmeans_inertia_parity(self):
+        from heat_tpu.cluster import KMeans
+
+        X = rng.standard_normal((300, 6)).astype(np.float32)
+        km = KMeans(n_clusters=3, random_state=0, max_iter=50).fit(ht.array(X, split=0))
+        C = km._cluster_centers.numpy()
+        lab = km._labels.numpy().ravel()
+        manual = float(((X - C[lab]) ** 2).sum())
+        self.assertLess(abs(km._inertia - manual) / manual, 1e-3)
+
+
+class TestGaussianNBPartialFit(TestCase):
+    def test_partial_fit_streams(self):
+        from heat_tpu.naive_bayes import GaussianNB
+
+        X = np.concatenate(
+            [rng.standard_normal((50, 3)) + 3, rng.standard_normal((50, 3)) - 3]
+        )
+        y = np.array([0] * 50 + [1] * 50)
+        perm = rng.permutation(100)
+        X, y = X[perm], y[perm]
+        g = GaussianNB()
+        g.partial_fit(ht.array(X[:60], split=0), ht.array(y[:60], split=0), classes=[0, 1])
+        g.partial_fit(ht.array(X[60:], split=0), ht.array(y[60:], split=0))
+        pred = g.predict(ht.array(X, split=0)).numpy().ravel()
+        self.assertGreater((pred == y).mean(), 0.95)
+
+
+class TestRNGInvariance(TestCase):
+    def test_split_invariant(self):
+        # counter-based RNG: same seed -> same global result at any sharding
+        ht.random.seed(7)
+        a = ht.random.rand(16, 4, split=0).numpy()
+        ht.random.seed(7)
+        b = ht.random.rand(16, 4).numpy()
+        np.testing.assert_allclose(a, b)
+        ht.random.seed(7)
+        c = ht.random.rand(16, 4, split=1).numpy()
+        np.testing.assert_allclose(a, c)

@@ -265,9 +265,10 @@ class TestSignal(TestCase):
         np.testing.assert_allclose(
             ht.convolve(ht.array(v), ht.array(a)).numpy(), np.convolve(v, a), rtol=1e-5
         )
-        # int inputs promote to float
+        # int inputs promote to float: int64 -> float64 under the reference's
+        # intuitive promotion table (reference signal.py:124-128 GPU path)
         r = ht.convolve(ht.arange(5), ht.array([1, 1, 1]))
-        self.assertIs(r.dtype, ht.float32)
+        self.assertIs(r.dtype, ht.float64)
         with pytest.raises(ValueError):
             ht.convolve(ht.ones((2, 2)), k)
         with pytest.raises(ValueError):

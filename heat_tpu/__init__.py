@@ -19,6 +19,7 @@ from .core import (
     exponential,
     factories,
     indexing,
+    io,
     logical,
     manipulations,
     memory,
@@ -62,9 +63,12 @@ def _bind_dndarray_methods():
         manipulations: [
             "expand_dims", "flatten", "ravel", "reshape", "resplit", "squeeze", "unique",
             "flip", "roll", "repeat", "tile", "moveaxis", "swapaxes", "collect",
+            "balance", "redistribute", "rot90",
         ],
         complex_math: ["conj"],
         indexing: ["nonzero"],
+        memory: ["copy"],
+        io: ["save", "save_hdf5", "save_netcdf", "save_csv"],
     }
     for module, names in _method_sources.items():
         for name in names:

@@ -446,8 +446,20 @@ def can_cast(from_, to, casting: str = "intuitive") -> builtins.bool:
         from_ = canonical_heat_type(from_.dtype).jax_type()
     elif isinstance(from_, (builtins.bool, builtins.int, builtins.float, builtins.complex)):
         # value-based scalar rule (reference types.py:707-710 examples):
-        # can_cast(1, float64) is True, can_cast(2.0e200, "u1") is False
-        from_ = np.min_scalar_type(from_)
+        # can_cast(1, float64) is True, can_cast(2.0e200, "u1") is False.
+        # True iff the value is representable in the target (round-trips).
+        try:
+            # normalize through the heat hierarchy: np.dtype(<heat class>)
+            # would silently produce the object dtype
+            target = np.dtype(canonical_heat_type(to).char())
+            src = np.array(from_)
+            if isinstance(from_, builtins.float) and np.isnan(src):
+                return np.issubdtype(target, np.inexact)
+            with np.errstate(all="ignore"):
+                cast = src.astype(target)
+                return builtins.bool(cast == src)
+        except (OverflowError, ValueError, TypeError):
+            return False
     if isinstance(to, type) and issubclass(to, datatype):
         to = to.jax_type()
     if casting == "intuitive":

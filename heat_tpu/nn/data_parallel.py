@@ -103,9 +103,6 @@ class DataParallel:
             return x.larray
         return jnp.asarray(x)
 
-    def _batch_sharding(self, ndim: int) -> NamedSharding:
-        return self.comm.sharding(ndim, 0)
-
     def _replicated(self) -> NamedSharding:
         return NamedSharding(self.comm.mesh, P())
 
@@ -162,9 +159,13 @@ class DataParallel:
         """One optimization step on a (sharded) batch; returns the loss."""
         if self.params is None:
             raise RuntimeError("DataParallel.init must be called before training")
+        from ..core.dndarray import _ensure_split
+
         xj, yj = self._as_jax(x), self._as_jax(y)
-        xb = jax.device_put(xj, self._batch_sharding(xj.ndim))
-        yb = jax.device_put(yj, self._batch_sharding(yj.ndim))
+        # _ensure_split tolerates batch sizes not divisible by the mesh
+        # (jitted with_sharding_constraint fallback)
+        xb = _ensure_split(xj, 0, self.comm)
+        yb = _ensure_split(yj, 0, self.comm)
         if self._stateful:
             self.params, self.state, self.opt_state, loss = self._train_step(
                 self.params, self.state, self.opt_state, xb, yb

@@ -17,6 +17,7 @@ dtype cast here).
 
 from __future__ import annotations
 
+import warnings
 from typing import Any, Callable, Optional
 
 import jax
@@ -286,8 +287,27 @@ class DASO:
         if self.params is None:
             raise RuntimeError("add_model must be called before step")
         batch_sh = NamedSharding(self.mesh, P(("dcn", "ici")))
-        xb = jax.device_put(jnp.asarray(x), batch_sh)
-        yb = jax.device_put(jnp.asarray(y), batch_sh)
+        xj, yj = jnp.asarray(x), jnp.asarray(y)
+        n_dev = self.nodes * self.ici_size
+        rem = xj.shape[0] % n_dev
+        if rem:
+            # the reference's DataLoader guarantees equal local batches by
+            # construction (reference utils/data/datatools.py chunking); the
+            # shard_map step needs the same, so drop the remainder like a
+            # drop_last loader would
+            if xj.shape[0] < n_dev:
+                raise ValueError(
+                    f"batch of {xj.shape[0]} is smaller than the {n_dev}-device mesh"
+                )
+            if not getattr(self, "_warned_remainder", False):
+                warnings.warn(
+                    f"batch size {xj.shape[0]} is not divisible by the {n_dev}-device "
+                    f"mesh; dropping the last {rem} sample(s) each step"
+                )
+                self._warned_remainder = True
+            xj, yj = xj[: xj.shape[0] - rem], yj[: yj.shape[0] - rem]
+        xb = jax.device_put(xj, batch_sh)
+        yb = jax.device_put(yj, batch_sh)
         state = self.state if self.state is not None else {}
         self.params, new_state, self.opt_state, loss = self._local_step(
             self.params, state, self.opt_state, xb, yb

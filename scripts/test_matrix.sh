@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Run the suite at mesh sizes 1/3/5/8 — the TPU-native analog of the
+# reference CI's "mpirun -n 1,3,5,8 pytest heat/" matrix
+# (reference Jenkinsfile:24-28; SURVEY.md §4).
+set -e
+cd "$(dirname "$0")/.."
+for n in "${@:-1 3 5 8}"; do
+  for size in $n; do
+    echo "=== mesh size $size ==="
+    HEAT_TPU_TEST_DEVICES=$size python -m pytest tests/ -q -x
+  done
+done

@@ -1,16 +1,24 @@
-"""Test configuration: force an 8-device virtual CPU mesh.
+"""Test configuration: force a virtual CPU mesh (default 8 devices).
 
 The reference CI runs the same suite at MPI world sizes 1/3/5/8
 (reference Jenkinsfile:24-28). The TPU-native analog (SURVEY.md §4) is a
-forced-host-platform CPU mesh: 8 virtual devices in one process, exercising
-the same shardings the real TPU slice would see.
+forced-host-platform CPU mesh, exercising the same shardings the real TPU
+slice would see. Set HEAT_TPU_TEST_DEVICES to run the matrix at other
+sizes (scripts/test_matrix.sh runs 1/3/5/8 like the reference).
 """
 
 import os
 
+import re
+
+_n = os.environ.get("HEAT_TPU_TEST_DEVICES", "8")
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# HEAT_TPU_TEST_DEVICES always wins: strip any pre-existing device-count flag
+# so the matrix script's 1/3/5/8 legs actually run at those sizes
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", _flags)
+os.environ["XLA_FLAGS"] = (
+    _flags.strip() + f" --xla_force_host_platform_device_count={_n}"
+).strip()
 
 import jax
 

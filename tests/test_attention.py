@@ -29,7 +29,14 @@ def setup_module():
     COMM = ht.get_comm()
 
 
-def _qkv(B=2, S=32, H=8, D=16, dtype=jnp.float32, seed=0):
+def _qkv(B=2, S=None, H=None, D=16, dtype=jnp.float32, seed=0):
+    # default sequence/head extents scale with the mesh so the suite passes
+    # at any HEAT_TPU_TEST_DEVICES (the reference's tests branch on comm.size
+    # the same way, e.g. reference test_communication.py ragged cases)
+    if S is None:
+        S = 8 * COMM.size
+    if H is None:
+        H = 2 * COMM.size
     ks = jax.random.split(jax.random.PRNGKey(seed), 3)
     shape = (B, S, H, D)
     q = jax.random.normal(ks[0], shape, dtype)
@@ -94,7 +101,7 @@ def test_ring_bf16_inputs_f32_accumulation():
 
 
 def test_ring_gradients_match_dense():
-    q, k, v = _qkv(B=1, S=16, H=2, D=8)
+    q, k, v = _qkv(B=1, S=4 * COMM.size, H=2, D=8)
 
     def loss_dense(q, k, v):
         return (dot_product_attention(q, k, v, causal=True) ** 2).sum()
@@ -110,26 +117,31 @@ def test_ring_gradients_match_dense():
 
 
 def test_ring_rejects_indivisible_seq():
-    q, k, v = _qkv(S=30)
+    if COMM.size == 1:
+        pytest.skip("every length divides a 1-device mesh")
+    q, k, v = _qkv(S=8 * COMM.size + 1)
     with pytest.raises(ValueError):
         ring_attention(q, k, v, comm=COMM)
 
 
 def test_ulysses_rejects_indivisible_heads():
-    q, k, v = _qkv(H=6)
+    if COMM.size == 1:
+        pytest.skip("every head count divides a 1-device mesh")
+    q, k, v = _qkv(H=COMM.size + 1)
     with pytest.raises(ValueError):
         ulysses_attention(q, k, v, comm=COMM)
 
 
 @pytest.mark.parametrize("backend", ["dense", "flash", "ring", "ulysses"])
 def test_mha_module_backends_agree(backend):
-    x = jax.random.normal(jax.random.PRNGKey(3), (2, 16, 32))
-    mod = MultiHeadAttention(num_heads=8, causal=True, backend=backend)
+    heads = 2 * COMM.size  # divisible for ulysses at any mesh size
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 8 * COMM.size, 4 * heads))
+    mod = MultiHeadAttention(num_heads=heads, causal=True, backend=backend)
     kwargs = {"comm": COMM} if backend in ("ring", "ulysses") else {}
-    variables = MultiHeadAttention(num_heads=8, causal=True, backend="dense").init(
+    variables = MultiHeadAttention(num_heads=heads, causal=True, backend="dense").init(
         jax.random.PRNGKey(0), x
     )
-    ref = MultiHeadAttention(num_heads=8, causal=True, backend="dense").apply(variables, x)
+    ref = MultiHeadAttention(num_heads=heads, causal=True, backend="dense").apply(variables, x)
     if backend in ("ring", "ulysses"):
         x_in = jax.device_put(x, COMM.sharding(3, 1))
     else:
@@ -140,6 +152,8 @@ def test_mha_module_backends_agree(backend):
 
 def test_long_sequence_ring_memory_shape():
     # a long-context smoke: S = 1024 over 8 devices -> 128 per chip
+    if 1024 % COMM.size:
+        pytest.skip("mesh size must divide 1024 for this smoke")
     q, k, v = _qkv(B=1, S=1024, H=4, D=8)
     qs, ks, vs = (_shard_seq(x, COMM) for x in (q, k, v))
     out = ring_attention(qs, ks, vs, causal=True, comm=COMM)

@@ -8,6 +8,10 @@ import numpy as np
 import pytest
 
 import heat_tpu as ht
+
+
+def _mesh_even():
+    return ht.get_comm().size % 2 == 0 and ht.get_comm().size > 1
 from heat_tpu.utils import checkpoint as ckpt
 from heat_tpu.utils import profiling
 
@@ -83,7 +87,7 @@ class TestCheckpoint:
             warmup_epochs=0,
             cooldown_epochs=0,
             comm=comm,
-            nodes=2,
+            nodes=2 if _mesh_even() else 1,
         )
         rng = np.random.default_rng(1)
         x = rng.standard_normal((16, 6)).astype(np.float32)
@@ -102,7 +106,7 @@ class TestCheckpoint:
             warmup_epochs=0,
             cooldown_epochs=0,
             comm=comm,
-            nodes=2,
+            nodes=2 if _mesh_even() else 1,
         )
         daso2.add_model(ht.nn.MLP(features=(8, 4)), 3, x[:2])
         daso2.restore(str(tmp_path))

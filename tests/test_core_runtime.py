@@ -71,28 +71,33 @@ class TestTypes(TestCase):
 class TestCommunication(TestCase):
     def test_world(self):
         comm = ht.get_comm()
-        self.assertEqual(comm.size, 8)
-        self.assertTrue(comm.is_distributed())
+        import jax
+
+        self.assertEqual(comm.size, len(jax.devices()))
+        self.assertEqual(comm.is_distributed(), comm.size > 1)
 
     def test_chunk(self):
         comm = ht.get_comm()
-        offset, lshape, slices = comm.chunk((16, 4), 0, rank=0)
+        p = comm.size
+        n = 2 * p
+        offset, lshape, slices = comm.chunk((n, 4), 0, rank=0)
         self.assertEqual(lshape, (2, 4))
         self.assertEqual(offset, 0)
-        offset, lshape, _ = comm.chunk((16, 4), 0, rank=7)
-        self.assertEqual(offset, 14)
-        # uneven
-        counts, displs = comm.counts_displs_shape((10,), 0)
-        self.assertEqual(sum(counts), 10)
-        self.assertEqual(counts[0], 2)
+        offset, lshape, _ = comm.chunk((n, 4), 0, rank=p - 1)
+        self.assertEqual(offset, n - 2)
+        # uneven: remainder spread over the lowest ranks (reference
+        # communication.py:193-203)
+        counts, displs = comm.counts_displs_shape((n + p // 2 + 1,), 0)
+        self.assertEqual(sum(counts), n + p // 2 + 1)
+        self.assertEqual(counts[0], (n + p // 2 + 1 + p - 1) // p)
         # replicated
-        _, lshape, _ = comm.chunk((16, 4), None)
-        self.assertEqual(lshape, (16, 4))
+        _, lshape, _ = comm.chunk((n, 4), None)
+        self.assertEqual(lshape, (n, 4))
 
     def test_lshape_map(self):
         comm = ht.get_comm()
         lmap = comm.lshape_map((16, 4), 0)
-        self.assertEqual(lmap.shape, (8, 2))
+        self.assertEqual(lmap.shape, (comm.size, 2))
         self.assertEqual(int(lmap[:, 0].sum()), 16)
 
 
@@ -159,19 +164,20 @@ class TestFactories(TestCase):
 
 class TestDNDarray(TestCase):
     def test_properties(self):
-        x = ht.array(np.arange(16.0, dtype=np.float32).reshape(4, 4), split=0)
-        self.assertEqual(x.shape, (4, 4))
-        self.assertEqual(x.gshape, (4, 4))
+        p = self.comm.size
+        x = ht.array(np.arange(4.0 * p, dtype=np.float32).reshape(p, 4), split=0)
+        self.assertEqual(x.shape, (p, 4))
+        self.assertEqual(x.gshape, (p, 4))
         self.assertEqual(x.ndim, 2)
-        self.assertEqual(x.size, 16)
-        self.assertEqual(x.gnumel, 16)
+        self.assertEqual(x.size, 4 * p)
+        self.assertEqual(x.gnumel, 4 * p)
         self.assertTrue(x.balanced)
         self.assertTrue(x.is_balanced())
         self.assertEqual(x.lshape, (1, 4))
         self.assertEqual(x.stride, (4, 1))
-        self.assertEqual(x.nbytes, 16 * 4)
+        self.assertEqual(x.nbytes, 4 * p * 4)
         lmap = x.lshape_map
-        self.assertEqual(int(lmap.numpy()[:, 0].sum()), 4)
+        self.assertEqual(int(lmap.numpy()[:, 0].sum()), p)
 
     def test_astype(self):
         x = ht.arange(4, split=0)
@@ -261,13 +267,15 @@ class TestDNDarray(TestCase):
         self.assertIn("...", s)
 
     def test_redistribute_rejects_ragged(self):
-        x = ht.arange(8, split=0)
+        p = self.comm.size
+        x = ht.arange(p, split=0)
         # the balanced identity map is accepted
-        x.redistribute_(target_map=np.ones((8, 1), dtype=np.int64))
-        ragged = np.zeros((8, 1), dtype=np.int64)
-        ragged[0] = 8
-        with pytest.raises(NotImplementedError):
-            x.redistribute_(target_map=ragged)
+        x.redistribute_(target_map=np.ones((p, 1), dtype=np.int64))
+        if p > 1:
+            ragged = np.zeros((p, 1), dtype=np.int64)
+            ragged[0] = p
+            with pytest.raises(NotImplementedError):
+                x.redistribute_(target_map=ragged)
 
     def test_halo_api(self):
         x = ht.arange(8, split=0)

@@ -35,9 +35,10 @@ class TestSplitTiles:
         assert t00.shape == st.get_tile_size((0, 0))
         st[0, 0] = np.zeros_like(t00)
         assert np.all(np.asarray(st[0, 0]) == 0)
-        # untouched region intact
+        # untouched region intact (only exists when there is >1 tile row)
         full = a.numpy()
-        assert full[t00.shape[0]:, :].sum() > 0
+        if t00.shape[0] < full.shape[0]:
+            assert full[t00.shape[0]:, :].sum() > 0
 
 
 class TestSquareDiagTiles:
@@ -112,9 +113,12 @@ class TestParityExtras:
         a.get_halo(2)
         n = a.comm.size
         if n > 1:
-            chunk = 16 // n
-            assert np.array_equal(np.asarray(a.halo_prev), a.numpy()[chunk - 2 : chunk])
-            assert np.array_equal(np.asarray(a.halo_next), a.numpy()[chunk : chunk + 2])
+            # boundaries follow the chunk rule (remainder on low ranks)
+            _, _, sl0 = a.comm.chunk((16,), 0, rank=0)
+            _, _, sl1 = a.comm.chunk((16,), 0, rank=1)
+            stop, start = sl0[0].stop, sl1[0].start
+            assert np.array_equal(np.asarray(a.halo_prev), a.numpy()[stop - 2 : stop])
+            assert np.array_equal(np.asarray(a.halo_next), a.numpy()[start : start + 2])
         assert a.create_lshape_map().shape == (n, 1)
 
     def test_mpi_combiners(self):

@@ -360,6 +360,32 @@ def _intuitive_can_cast(src: np.dtype, dst: np.dtype) -> builtins.bool:
     return np.can_cast(src, dst, casting="safe")
 
 
+def _scalar_fits(value, target: np.dtype) -> builtins.bool:
+    """Value-based castability: rounding allowed, overflow/truncation not."""
+    if np.issubdtype(target, np.bool_):
+        return isinstance(value, builtins.bool) or value in (0, 1)
+    if isinstance(value, builtins.complex) and not np.issubdtype(target, np.complexfloating):
+        if value.imag != 0:
+            return False
+        value = value.real
+    if np.issubdtype(target, np.integer):
+        if isinstance(value, builtins.float) and not builtins.float(value).is_integer():
+            return False
+        info = np.iinfo(target)
+        try:
+            return info.min <= value <= info.max
+        except (OverflowError, ValueError):
+            return False
+    # float/complex target: any magnitude within range; nan/inf always fit
+    v = builtins.abs(value)
+    if np.isnan(v) or np.isinf(v):
+        return True
+    comp = target if np.issubdtype(target, np.floating) else np.dtype(
+        np.float32 if target == np.dtype(np.complex64) else np.float64
+    )
+    return v <= builtins.float(np.finfo(comp).max)
+
+
 def promote_types(type1, type2) -> type:
     """Smallest type in the reference's scan order that both inputs cast to
     under the "intuitive" rule (reference heat/core/types.py:755-761, 836).
@@ -447,19 +473,19 @@ def can_cast(from_, to, casting: str = "intuitive") -> builtins.bool:
     elif isinstance(from_, (builtins.bool, builtins.int, builtins.float, builtins.complex)):
         # value-based scalar rule (reference types.py:707-710 examples):
         # can_cast(1, float64) is True, can_cast(2.0e200, "u1") is False.
-        # True iff the value is representable in the target (round-trips).
+        # Precision loss is fine (3.14 -> float32); overflow and
+        # int-truncation are not — numpy's classic value-based semantics.
+        if casting == "unsafe":
+            return True
         try:
             # normalize through the heat hierarchy: np.dtype(<heat class>)
             # would silently produce the object dtype
             target = np.dtype(canonical_heat_type(to).char())
-            src = np.array(from_)
-            if isinstance(from_, builtins.float) and np.isnan(src):
-                return np.issubdtype(target, np.inexact)
-            with np.errstate(all="ignore"):
-                cast = src.astype(target)
-                return builtins.bool(cast == src)
-        except (OverflowError, ValueError, TypeError):
+        except (TypeError, ValueError):
             return False
+        if casting == "no":
+            return np.result_type(from_) == target
+        return _scalar_fits(from_, target)
     if isinstance(to, type) and issubclass(to, datatype):
         to = to.jax_type()
     if casting == "intuitive":

@@ -141,6 +141,11 @@ def reset() -> None:
     Timer._registry.clear()
 
 
+# class-level aliases so `Timer.report()` / `Timer.reset()` read naturally
+Timer.report = staticmethod(report)
+Timer.reset = staticmethod(reset)
+
+
 def device_memory_stats() -> Dict[str, Dict[str, int]]:
     """Live/peak bytes per device, where the backend exposes memory_stats()."""
     out: Dict[str, Dict[str, int]] = {}

@@ -4,9 +4,7 @@
 # (reference Jenkinsfile:24-28; SURVEY.md §4).
 set -e
 cd "$(dirname "$0")/.."
-for n in "${@:-1 3 5 8}"; do
-  for size in $n; do
-    echo "=== mesh size $size ==="
-    HEAT_TPU_TEST_DEVICES=$size python -m pytest tests/ -q -x
-  done
+for size in ${@:-1 3 5 8}; do
+  echo "=== mesh size $size ==="
+  HEAT_TPU_TEST_DEVICES=$size python -m pytest tests/ -q -x
 done

@@ -11,14 +11,15 @@ import os
 
 import re
 
-_n = os.environ.get("HEAT_TPU_TEST_DEVICES", "8")
+_n = os.environ.get("HEAT_TPU_TEST_DEVICES")
 _flags = os.environ.get("XLA_FLAGS", "")
-# HEAT_TPU_TEST_DEVICES always wins: strip any pre-existing device-count flag
-# so the matrix script's 1/3/5/8 legs actually run at those sizes
-_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", _flags)
-os.environ["XLA_FLAGS"] = (
-    _flags.strip() + f" --xla_force_host_platform_device_count={_n}"
-).strip()
+if _n is not None:
+    # an explicit HEAT_TPU_TEST_DEVICES wins over any pre-existing flag so
+    # the matrix script's 1/3/5/8 legs actually run at those sizes
+    _flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", _flags).strip()
+    os.environ["XLA_FLAGS"] = f"{_flags} --xla_force_host_platform_device_count={_n}".strip()
+elif "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = f"{_flags} --xla_force_host_platform_device_count=8".strip()
 
 import jax
 

@@ -25,10 +25,10 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-__all__ = ["csv_scan", "csv_parse", "native_available"]
+__all__ = ["csv_scan", "csv_parse", "csv_write", "native_available"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "csv_reader.cpp")
+_SRCS = [os.path.join(_DIR, "csv_reader.cpp"), os.path.join(_DIR, "csv_writer.cpp")]
 _SO = os.path.join(_DIR, "libheatcsv.so")
 
 _lock = threading.Lock()
@@ -38,7 +38,7 @@ _tried = False
 
 def _build() -> bool:
     cmd = [
-        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO, "-lpthread",
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", *_SRCS, "-o", _SO, "-lpthread",
     ]
     try:
         res = subprocess.run(cmd, capture_output=True, timeout=120)
@@ -56,7 +56,9 @@ def _load() -> Optional[ctypes.CDLL]:
         _tried = True
         if os.environ.get("HEAT_TPU_NO_NATIVE"):
             return None
-        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+        if not os.path.exists(_SO) or any(
+            os.path.getmtime(_SO) < os.path.getmtime(src) for src in _SRCS
+        ):
             if not _build():
                 return None
         try:
@@ -73,6 +75,11 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_longlong, ctypes.POINTER(ctypes.c_double), ctypes.c_int,
         ]
         lib.csv_parse.restype = ctypes.c_longlong
+        lib.csv_write.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_double), ctypes.c_longlong,
+            ctypes.c_longlong, ctypes.c_char, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.csv_write.restype = ctypes.c_longlong
         _lib = lib
         return _lib
 
@@ -117,3 +124,33 @@ def csv_parse(
     if done != rows:
         raise ValueError(f"malformed CSV {path}: parsed {done} of {rows} rows")
     return out
+
+
+def csv_write(
+    path: str,
+    data: np.ndarray,
+    sep: str = ",",
+    decimals: int = -1,
+    append: bool = False,
+    n_threads: Optional[int] = None,
+) -> int:
+    """Write a 2-D float array as CSV with C++ formatting threads.
+
+    ``decimals < 0`` writes shortest-round-trip (%.17g) values; ``append``
+    adds to an existing file (used after Python writes header lines).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native CSV writer unavailable")
+    arr = np.ascontiguousarray(data, dtype=np.float64)
+    if arr.ndim != 2:
+        raise ValueError(f"need a 2-D array, got {arr.ndim}-D")
+    nt = n_threads or min(os.cpu_count() or 1, 16)
+    done = lib.csv_write(
+        path.encode(), arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        arr.shape[0], arr.shape[1], sep.encode()[:1], decimals,
+        1 if append else 0, nt,
+    )
+    if done != arr.shape[0]:
+        raise IOError(f"native CSV write to {path} failed")
+    return int(done)

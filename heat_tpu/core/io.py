@@ -241,11 +241,27 @@ def save_csv(
     arr = data.numpy()
     if arr.ndim == 1:
         arr = arr[:, None]
-    fmt = f"%.{decimals}f" if decimals >= 0 else "%s"
     with open(path, "w", encoding=encoding, newline="") as f:
         if header_lines:
             for line in header_lines:
                 f.write(line if line.endswith("\n") else line + "\n")
+    # float payloads go through the native multithreaded writer
+    # (heat_tpu/_native/csv_writer.cpp). Integers stay on the exact python
+    # path (float64 transport would corrupt int64 > 2^53); the sep/encoding
+    # guards mirror load_csv's native gate.
+    if (
+        np.issubdtype(arr.dtype, np.floating)
+        and len(sep) == 1
+        and ord(sep) < 128
+        and encoding.replace("-", "").lower() in ("utf8", "ascii")
+    ):
+        from .. import _native
+
+        if _native.native_available():
+            _native.csv_write(path, arr, sep=sep, decimals=decimals, append=True)
+            return
+    fmt = f"%.{decimals}f" if decimals >= 0 else "%s"
+    with open(path, "a", encoding=encoding, newline="") as f:
         writer = csv_module.writer(f, delimiter=sep)
         for row in arr:
             writer.writerow([fmt % v if decimals >= 0 else v for v in row])

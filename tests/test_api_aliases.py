@@ -1,0 +1,87 @@
+"""Alias and secondary-spelling coverage: the reference exports many numpy
+spellings of the same op (multiply/mul, power/pow, greater/gt, ...); exercise
+each against the numpy oracle so a broken alias binding cannot hide.
+"""
+
+import numpy as np
+
+import heat_tpu as ht
+from harness import TestCase
+
+rng = np.random.default_rng(9)
+
+
+class TestArithmeticAliases(TestCase):
+    def test_float_aliases(self):
+        a_np = rng.standard_normal((6, 4))
+        b_np = rng.standard_normal((6, 4)) + 2.0
+        a, b = ht.array(a_np, split=0), ht.array(b_np, split=0)
+        for ht_fn, np_fn in [
+            (ht.mul, np.multiply),
+            (ht.multiply, np.multiply),
+            (ht.div, np.divide),
+            (ht.divide, np.divide),
+            (ht.subtract, np.subtract),
+            (ht.pow, np.power),
+            (ht.power, np.power),
+            (ht.floordiv, np.floor_divide),
+            (ht.floor_divide, np.floor_divide),
+        ]:
+            np.testing.assert_allclose(
+                ht_fn(a, b).numpy(), np_fn(a_np, b_np), rtol=1e-6, err_msg=str(np_fn)
+            )
+        np.testing.assert_allclose(ht.positive(a).numpy(), +a_np)
+        np.testing.assert_allclose(ht.absolute(a).numpy(), np.abs(a_np))
+        np.testing.assert_allclose(ht.sgn(a).numpy(), np.sign(a_np))
+
+    def test_bitwise_aliases(self):
+        x_np = rng.integers(0, 64, (8,), dtype=np.int32)
+        y_np = rng.integers(0, 64, (8,), dtype=np.int32)
+        x, y = ht.array(x_np, split=0), ht.array(y_np, split=0)
+        np.testing.assert_array_equal(ht.bitwise_or(x, y).numpy(), x_np | y_np)
+        np.testing.assert_array_equal(ht.bitwise_xor(x, y).numpy(), x_np ^ y_np)
+        np.testing.assert_array_equal(ht.bitwise_not(x).numpy(), ~x_np)
+        np.testing.assert_array_equal(ht.invert(x).numpy(), ~x_np)
+        np.testing.assert_array_equal(ht.right_shift(x, 2).numpy(), x_np >> 2)
+        np.testing.assert_array_equal(ht.left_shift(x, 2).numpy(), x_np << 2)
+
+    def test_cumproduct(self):
+        x_np = rng.random((12,)).astype(np.float32) + 0.5
+        x = ht.array(x_np, split=0)
+        # axis is required, as in the reference (reference arithmetics.py:224)
+        np.testing.assert_allclose(
+            ht.cumproduct(x, 0).numpy(), np.cumprod(x_np), rtol=1e-5
+        )
+
+
+class TestRelationalAliases(TestCase):
+    def test_all_spellings(self):
+        a_np = rng.integers(0, 5, (10,))
+        b_np = rng.integers(0, 5, (10,))
+        a, b = ht.array(a_np, split=0), ht.array(b_np, split=0)
+        for ht_fn, np_fn in [
+            (ht.gt, np.greater),
+            (ht.greater, np.greater),
+            (ht.ge, np.greater_equal),
+            (ht.greater_equal, np.greater_equal),
+            (ht.lt, np.less),
+            (ht.less, np.less),
+            (ht.le, np.less_equal),
+            (ht.less_equal, np.less_equal),
+            (ht.ne, np.not_equal),
+            (ht.not_equal, np.not_equal),
+            (ht.eq, np.equal),
+        ]:
+            np.testing.assert_array_equal(
+                ht_fn(a, b).numpy().astype(bool), np_fn(a_np, b_np), err_msg=str(np_fn)
+            )
+
+
+class TestManipulationWrappers(TestCase):
+    def test_balance_redistribute_functions(self):
+        x = ht.arange(10, split=0)  # uneven over 8 -> balance is exercised
+        b = ht.balance(x)
+        np.testing.assert_array_equal(b.numpy(), np.arange(10))
+        r = ht.redistribute(x)
+        np.testing.assert_array_equal(r.numpy(), np.arange(10))
+        self.assertEqual(b.split, 0)

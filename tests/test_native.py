@@ -92,3 +92,44 @@ class TestLoadCSVWiring:
         fallback = ht.load_csv(str(p), header_lines=1, split=0)
         np.testing.assert_allclose(native.numpy(), fallback.numpy(), rtol=1e-6)
         np.testing.assert_allclose(native.numpy(), a, rtol=1e-5)
+
+
+@pytest.mark.skipif(not _native.native_available(), reason="no native toolchain")
+class TestNativeCSVWriter:
+    def test_roundtrip_exact(self, tmp_path):
+        rng = np.random.default_rng(0)
+        arr = rng.standard_normal((257, 5))
+        p = str(tmp_path / "w.csv")
+        _native.csv_write(p, arr)
+        back = _native.csv_parse(p)
+        np.testing.assert_array_equal(back, arr)  # shortest round-trip is exact
+
+    def test_decimals_and_sep(self, tmp_path):
+        arr = np.array([[1.23456, -2.5], [0.5, 3.0]])
+        p = str(tmp_path / "d.csv")
+        _native.csv_write(p, arr, sep=";", decimals=2)
+        lines = open(p).read().strip().split("\n")
+        assert lines[0] == "1.23;-2.50"
+        assert lines[1] == "0.50;3.00"
+
+    def test_append_mode(self, tmp_path):
+        p = str(tmp_path / "a.csv")
+        with open(p, "w") as f:
+            f.write("# header\n")
+        _native.csv_write(p, np.ones((2, 2)), append=True)
+        lines = open(p).read().strip().split("\n")
+        assert lines[0] == "# header" and len(lines) == 3
+
+    def test_save_csv_wiring(self, tmp_path):
+        import jax
+
+        x = ht.array(np.random.default_rng(1).standard_normal((64, 3)), split=0)
+        p = str(tmp_path / "s.csv")
+        ht.save_csv(x, p, header_lines=["c0,c1,c2"])
+        # load_csv defaults to float32 like the reference; match x's dtype
+        y = ht.load_csv(p, header_lines=1, split=0, dtype=x.dtype)
+        np.testing.assert_array_equal(y.numpy(), x.numpy())
+
+    def test_write_failure_raises(self, tmp_path):
+        with pytest.raises((IOError, RuntimeError)):
+            _native.csv_write(str(tmp_path / "no" / "dir.csv"), np.ones((2, 2)))

@@ -1,0 +1,49 @@
+"""KNN classification demo (analog of reference examples/classification/demo_knn.py).
+
+Train/verify split over the iris-like dataset with a leave-chunk-out loop;
+reports classification accuracy per fold.
+
+Run (CPU mesh):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/knn_demo.py
+"""
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.classification import KNeighborsClassifier
+
+
+def calculate_accuracy(new_y, verification_y):
+    """Fraction of matching integer labels (reference demo_knn.py:27-52)."""
+    a = np.asarray(new_y.numpy()).ravel()
+    b = np.asarray(verification_y.numpy()).ravel()
+    return float((a == b).mean())
+
+
+def main():
+    X, Y = ht.datasets.iris_like(split=0, return_labels=True)
+    n = X.shape[0]
+    fold = n // 5
+
+    accuracies = []
+    for k in range(5):
+        lo, hi = k * fold, (k + 1) * fold
+        mask = np.ones(n, dtype=bool)
+        mask[lo:hi] = False
+        train_x = X[np.nonzero(mask)[0]]
+        train_y = Y[np.nonzero(mask)[0]]
+        test_x = X[np.arange(lo, hi)]
+        test_y = Y[np.arange(lo, hi)]
+
+        knn = KNeighborsClassifier(n_neighbors=5).fit(train_x, train_y)
+        pred = knn.predict(test_x)
+        acc = calculate_accuracy(pred, test_y)
+        accuracies.append(acc)
+        print(f"fold {k}: accuracy {acc:.3f}")
+
+    print(f"mean accuracy: {np.mean(accuracies):.3f}")
+
+
+if __name__ == "__main__":
+    main()

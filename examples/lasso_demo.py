@@ -1,0 +1,39 @@
+"""Lasso regression demo (analog of reference examples/lasso/demo.py).
+
+Coordinate-descent lasso over the diabetes-like dataset, sweeping the
+regularization strength and printing the coefficient paths.
+
+Run (CPU mesh):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/lasso_demo.py
+"""
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.regression import Lasso
+
+
+def main():
+    X = ht.datasets.diabetes_like(split=0)
+    # synthesize a sparse ground truth like the reference's diabetes target
+    rng = np.random.default_rng(0)
+    w = np.zeros(X.shape[1], np.float32)
+    w[[1, 4, 7]] = [2.5, -1.5, 3.0]
+    y_np = X.numpy() @ w + 0.05 * rng.standard_normal(X.shape[0]).astype(np.float32)
+    y = ht.array(y_np[:, None], split=0)
+
+    # normalize like the reference demo (demo.py:27-28)
+    X = X / ht.sqrt(ht.mean(X**2, axis=0))
+
+    print(f"{'lambda':>8} | nonzero coefficients")
+    for lam in (0.001, 0.01, 0.1, 0.5, 1.0):
+        estimator = Lasso(lam=lam, max_iter=200)
+        estimator.fit(X, y)
+        coef = np.asarray(estimator.coef_.numpy()).ravel()
+        nz = np.flatnonzero(np.abs(coef) > 1e-3)
+        print(f"{lam:8.3f} | {len(nz)} of {len(coef)}: {np.round(coef[nz], 3).tolist()}")
+
+
+if __name__ == "__main__":
+    main()

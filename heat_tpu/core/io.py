@@ -241,27 +241,36 @@ def save_csv(
     arr = data.numpy()
     if arr.ndim == 1:
         arr = arr[:, None]
-    with open(path, "w", encoding=encoding, newline="") as f:
-        if header_lines:
-            for line in header_lines:
-                f.write(line if line.endswith("\n") else line + "\n")
+
+    def write_header(f):
+        for line in header_lines or ():
+            f.write(line if line.endswith("\n") else line + "\n")
+
     # float payloads go through the native multithreaded writer
     # (heat_tpu/_native/csv_writer.cpp). Integers stay on the exact python
     # path (float64 transport would corrupt int64 > 2^53); the sep/encoding
-    # guards mirror load_csv's native gate.
+    # guards mirror load_csv's native gate, and like load_csv any native
+    # failure falls back to the python writer.
     if (
         np.issubdtype(arr.dtype, np.floating)
         and len(sep) == 1
         and ord(sep) < 128
         and encoding.replace("-", "").lower() in ("utf8", "ascii")
     ):
-        from .. import _native
+        try:
+            from .. import _native
 
-        if _native.native_available():
-            _native.csv_write(path, arr, sep=sep, decimals=decimals, append=True)
-            return
+            if _native.native_available():
+                with open(path, "w", encoding=encoding, newline="") as f:
+                    write_header(f)
+                _native.csv_write(path, arr, sep=sep, decimals=decimals, append=True)
+                return
+        except Exception:
+            pass  # fall through to the python writer (rewrites from scratch)
     fmt = f"%.{decimals}f" if decimals >= 0 else "%s"
-    with open(path, "a", encoding=encoding, newline="") as f:
-        writer = csv_module.writer(f, delimiter=sep)
+    with open(path, "w", encoding=encoding, newline="") as f:
+        write_header(f)
+        # match the native writer's row terminator (csv defaults to \r\n)
+        writer = csv_module.writer(f, delimiter=sep, lineterminator="\n")
         for row in arr:
             writer.writerow([fmt % v if decimals >= 0 else v for v in row])

@@ -41,6 +41,12 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
         self.class_prior_ = None
         self.epsilon_ = None
 
+    @property
+    def sigma_(self):
+        """Per-class feature variances — the reference's name for ``var_``
+        (reference gaussianNB.py:38)."""
+        return self.var_
+
     # ------------------------------------------------------------------
     @staticmethod
     def _update_mean_variance(n_past, mu, var, X, sample_weight=None):

@@ -1,0 +1,22 @@
+"""Smoke-run every example script in-process on the test mesh (the reference
+exercises its demos through the estimator tests; running them directly also
+guards the doc surface)."""
+
+import runpy
+import sys
+
+import numpy as np
+import pytest
+
+
+@pytest.mark.parametrize("script", ["knn_demo", "lasso_demo", "cluster_demo"])
+def test_example_runs(script, capsys):
+    runpy.run_path(f"examples/{script}.py", run_name="__main__")
+    out = capsys.readouterr().out
+    assert out.strip(), f"{script} produced no output"
+    if script == "knn_demo":
+        assert "mean accuracy" in out
+        acc = float(out.strip().rsplit(" ", 1)[-1])
+        assert acc > 0.9
+    if script == "lasso_demo":
+        assert "lambda" in out

@@ -1,0 +1,112 @@
+"""Deep linalg sweeps (model: reference linalg tests, test_basics.py ~2.1k LoC):
+non-square matmul across every split combination, QR shape/orthogonality
+invariants on wide/tall/square inputs, det/inv/trace/norm split sweeps.
+"""
+
+import numpy as np
+
+import heat_tpu as ht
+from harness import TestCase
+
+rng = np.random.default_rng(11)
+
+
+class TestMatmulDepth(TestCase):
+    def test_nonsquare_all_splits(self):
+        A = rng.standard_normal((24, 7))
+        B = rng.standard_normal((7, 18))
+        for sa in (None, 0, 1):
+            for sb in (None, 0, 1):
+                c = ht.matmul(ht.array(A, split=sa), ht.array(B, split=sb))
+                np.testing.assert_allclose(
+                    c.numpy(), A @ B, atol=1e-10, err_msg=f"split {sa}x{sb}"
+                )
+
+    def test_matvec(self):
+        A = rng.standard_normal((6, 8))
+        v = rng.standard_normal(8)
+        np.testing.assert_allclose(
+            (ht.array(A, split=0) @ ht.array(v, split=0)).numpy(), A @ v, atol=1e-10
+        )
+        np.testing.assert_allclose(
+            (ht.array(v, split=0) @ ht.array(A.T, split=1)).numpy(), v @ A.T, atol=1e-10
+        )
+
+
+class TestQRDepth(TestCase):
+    def test_shapes_and_invariants(self):
+        for shape in ((8, 20), (20, 8), (16, 16)):
+            X = rng.standard_normal(shape)
+            for split in (None, 0, 1):
+                q, r = ht.linalg.qr(ht.array(X, split=split))
+                np.testing.assert_allclose(
+                    (q @ r).numpy(), X, atol=1e-8, err_msg=f"{shape} split={split}"
+                )
+                qn = q.numpy()
+                np.testing.assert_allclose(
+                    qn.T @ qn, np.eye(qn.shape[1]), atol=1e-8,
+                    err_msg=f"Q not orthonormal {shape} split={split}",
+                )
+                rn = r.numpy()
+                assert np.allclose(rn, np.triu(rn)), f"R not triangular {shape} {split}"
+
+    def test_tall_skinny_large(self):
+        # the TSQR reduction-tree path on a genuinely tall matrix
+        X = rng.standard_normal((512, 8))
+        q, r = ht.linalg.qr(ht.array(X, split=0))
+        np.testing.assert_allclose((q @ r).numpy(), X, atol=1e-8)
+
+
+class TestSquareAlgos(TestCase):
+    def test_det_inv_all_splits(self):
+        X = rng.standard_normal((10, 10)) + 10 * np.eye(10)
+        for split in (None, 0, 1):
+            a = ht.array(X, split=split)
+            np.testing.assert_allclose(
+                float(ht.linalg.det(a)), np.linalg.det(X), rtol=1e-8
+            )
+            np.testing.assert_allclose(
+                ht.linalg.inv(a).numpy(), np.linalg.inv(X), atol=1e-8
+            )
+
+    def test_trace_tri_all_splits(self):
+        X = rng.standard_normal((7, 7))
+        for split in (None, 0, 1):
+            a = ht.array(X, split=split)
+            np.testing.assert_allclose(float(ht.linalg.trace(a)), np.trace(X))
+            np.testing.assert_allclose(ht.tril(a).numpy(), np.tril(X))
+            np.testing.assert_allclose(ht.triu(a, k=1).numpy(), np.triu(X, 1))
+
+
+class TestNormsAndProducts(TestCase):
+    def test_matrix_vector_norms(self):
+        X = rng.standard_normal((9, 5))
+        a = ht.array(X, split=0)
+        np.testing.assert_allclose(float(ht.linalg.matrix_norm(a)), np.linalg.norm(X))
+        np.testing.assert_allclose(
+            float(ht.linalg.matrix_norm(a, ord=1)), np.linalg.norm(X, 1)
+        )
+        np.testing.assert_allclose(
+            float(ht.linalg.matrix_norm(a, ord=np.inf)), np.linalg.norm(X, np.inf)
+        )
+        v = ht.array(X[0], split=0)
+        np.testing.assert_allclose(
+            float(ht.linalg.vector_norm(v, ord=1)), np.linalg.norm(X[0], 1)
+        )
+
+    def test_vdot_vecdot_projection(self):
+        u = rng.standard_normal(12)
+        v = rng.standard_normal(12)
+        np.testing.assert_allclose(
+            float(ht.linalg.vdot(ht.array(u, split=0), ht.array(v, split=0))),
+            np.vdot(u, v),
+        )
+        A = rng.standard_normal((4, 12))
+        B = rng.standard_normal((4, 12))
+        np.testing.assert_allclose(
+            ht.linalg.vecdot(ht.array(A, split=0), ht.array(B, split=0)).numpy(),
+            np.sum(A * B, -1),
+            atol=1e-10,
+        )
+        got = ht.linalg.projection(ht.array(u, split=0), ht.array(v, split=0)).numpy()
+        np.testing.assert_allclose(got, (np.dot(u, v) / np.dot(v, v)) * v, atol=1e-10)

@@ -1,0 +1,65 @@
+"""Parallelism-strategy package.
+
+The reference's parallelism vocabulary is 1-D data/array parallelism plus
+resharding, rings, halos, and hierarchical DP (SURVEY.md §2.3); TP/PP/EP are
+explicitly absent there. This package makes them first-class for the TPU
+build, on top of multi-axis ``jax.sharding.Mesh``es:
+
+- :func:`make_mesh` — named multi-axis meshes ('dp', 'tp', 'pp', 'ep', ...).
+- :mod:`tensor <heat_tpu.parallel.tensor>` — Megatron-style column/row
+  parallel Dense layers expressed as GSPMD sharding constraints (XLA inserts
+  the all-gather/reduce-scatter; nothing is hand-scheduled).
+- :mod:`pipeline <heat_tpu.parallel.pipeline>` — GPipe-style microbatched
+  pipeline over a mesh axis via ``shard_map`` + ``ppermute`` (the schedule IS
+  the algorithm, so it is written explicitly).
+- :mod:`expert <heat_tpu.parallel.expert>` — top-1 mixture-of-experts layer
+  with ``all_to_all`` token dispatch over the expert axis.
+
+Sequence parallelism (ring / Ulysses attention) lives in
+:mod:`heat_tpu.nn.attention` and composes with these meshes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .expert import MoELayer, moe_apply
+from .pipeline import pipeline_apply, pipeline_stage_params
+from .tensor import ColumnParallelDense, RowParallelDense, TPMLPBlock
+
+__all__ = [
+    "ColumnParallelDense",
+    "MoELayer",
+    "RowParallelDense",
+    "TPMLPBlock",
+    "make_mesh",
+    "moe_apply",
+    "pipeline_apply",
+    "pipeline_stage_params",
+]
+
+
+def make_mesh(
+    axes: Sequence[Tuple[str, int]],
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a named multi-axis mesh, e.g. ``make_mesh([("dp", 2), ("tp", 4)])``.
+
+    Axis sizes must multiply to the device count. Axis order fixes locality:
+    later axes are nearest neighbors (put 'tp' last so its collectives ride
+    the fastest interconnect, the standard TPU layout recipe).
+    """
+    if devices is None:
+        devices = jax.devices()
+    names = tuple(n for n, _ in axes)
+    sizes = tuple(int(s) for _, s in axes)
+    total = int(np.prod(sizes))
+    if total != len(devices):
+        raise ValueError(
+            f"mesh axes {dict(axes)} need {total} devices, have {len(devices)}"
+        )
+    return Mesh(np.asarray(devices).reshape(sizes), names)

@@ -1,0 +1,115 @@
+"""Expert parallelism: top-1 mixture-of-experts with all_to_all dispatch.
+
+Absent from the reference (SURVEY.md §2.3); TPU-native here. One expert per
+device along the 'ep' mesh axis. Tokens are routed top-1, packed into fixed
+per-destination buffers (capacity = local token count, so nothing is ever
+dropped), exchanged with ``lax.all_to_all`` over ICI, transformed by the
+local expert FFN, and exchanged back — the Switch-Transformer data path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["MoELayer", "moe_apply"]
+
+
+def moe_apply(
+    expert_fn: Callable[[Any, jax.Array], jax.Array],
+    expert_params: Any,
+    router_weights: jax.Array,
+    x: jax.Array,
+    mesh: Mesh,
+    axis: str = "ep",
+):
+    """Route row-sharded tokens ``x (n, d)`` through per-device experts.
+
+    ``expert_params`` has a leading expert axis of size E (sharded over
+    ``axis``); ``router_weights (d, E)`` is replicated. Returns (n, d)
+    sharded like ``x``, each token scaled by its router probability
+    (straight-through top-1, Switch style).
+    """
+    n_exp = mesh.shape[axis]
+    if x.shape[0] % n_exp:
+        raise ValueError(f"token count {x.shape[0]} not divisible by {n_exp} experts")
+
+    def kernel(p, rw, xs):
+        p = jax.tree.map(lambda a: a[0], p)  # this device's expert
+        t = xs.shape[0]  # local tokens; also the per-destination capacity
+        logits = xs @ rw  # (t, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        assign = jnp.argmax(logits, axis=-1)  # (t,)
+        gate = jnp.take_along_axis(probs, assign[:, None], axis=1)[:, 0]  # (t,)
+
+        # pack: slot j*t + rank-within-expert-j (capacity t never overflows)
+        onehot = jax.nn.one_hot(assign, n_exp, dtype=jnp.int32)  # (t, E)
+        rank = (jnp.cumsum(onehot, axis=0) - 1)  # rank among same-expert tokens
+        slot = assign * t + jnp.take_along_axis(rank, assign[:, None], axis=1)[:, 0]
+        dispatch = jnp.zeros((n_exp * t, xs.shape[1]), xs.dtype).at[slot].set(xs)
+        dispatch = dispatch.reshape(n_exp, t, xs.shape[1])
+
+        # exchange: block j goes to device j; we receive one block per source
+        received = jax.lax.all_to_all(dispatch, axis, split_axis=0, concat_axis=0)
+        flat = received.reshape(n_exp * t, xs.shape[1])
+        transformed = expert_fn(p, flat).reshape(n_exp, t, xs.shape[1])
+
+        # return trip and unpack to original token order
+        back = jax.lax.all_to_all(transformed, axis, split_axis=0, concat_axis=0)
+        out = back.reshape(n_exp * t, xs.shape[1])[slot]
+        return out * gate[:, None]
+
+    return jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=(P(axis), P(), P(axis)),
+            out_specs=P(axis),
+            check_vma=False,
+        )
+    )(expert_params, router_weights, x)
+
+
+class MoELayer(nn.Module):
+    """Flax wrapper: a bank of E expert MLPs + router, applied via
+    :func:`moe_apply` when given a mesh, or densely (oracle path) without."""
+
+    n_experts: int
+    hidden: int
+    features: int
+
+    def setup(self):
+        self.router = self.param(
+            "router", nn.initializers.lecun_normal(), (self.features, self.n_experts)
+        )
+        self.wi = self.param(
+            "wi", nn.initializers.lecun_normal(), (self.n_experts, self.features, self.hidden)
+        )
+        self.wo = self.param(
+            "wo", nn.initializers.lecun_normal(), (self.n_experts, self.hidden, self.features)
+        )
+
+    @staticmethod
+    def expert_fn(p, x):
+        wi, wo = p
+        return jax.nn.gelu(x @ wi) @ wo
+
+    def __call__(self, x, mesh: Mesh = None, axis: str = "ep"):
+        if mesh is not None:
+            return moe_apply(
+                self.expert_fn, (self.wi, self.wo), self.router, x, mesh, axis
+            )
+        # dense oracle: every token through its argmax expert, locally
+        logits = x @ self.router
+        probs = jax.nn.softmax(logits, axis=-1)
+        assign = jnp.argmax(logits, axis=-1)
+        gate = jnp.take_along_axis(probs, assign[:, None], axis=1)[:, 0]
+        per_expert = jnp.einsum("td,edh->teh", x, self.wi)
+        per_expert = jax.nn.gelu(per_expert)
+        outs = jnp.einsum("teh,ehd->ted", per_expert, self.wo)
+        picked = jnp.take_along_axis(outs, assign[:, None, None], axis=1)[:, 0]
+        return picked * gate[:, None]

@@ -1,0 +1,93 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+
+Absent from the reference (SURVEY.md §2.3); built TPU-native here. Unlike the
+TP layers (where GSPMD infers communication), a pipeline's schedule IS the
+algorithm, so it is written explicitly with ``shard_map``: each device owns
+one stage's parameters, activations hop stage→stage over ``ppermute`` (one
+ICI neighbor exchange per tick), and the classic GPipe fill/drain ramp runs
+``M + P - 1`` ticks for M microbatches on P stages.
+
+Stages must be homogeneous (same activation shape in/out), the standard
+transformer-block setting.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["pipeline_apply", "pipeline_stage_params"]
+
+
+def pipeline_stage_params(per_stage_params: Sequence[Any]):
+    """Stack a list of per-stage param pytrees along a new leading axis
+    (shard it over the 'pp' mesh axis when placing)."""
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    axis: str = "pp",
+    n_microbatches: Optional[int] = None,
+):
+    """Run ``x`` through P pipeline stages: ``stage_fn(params_p, act)`` per
+    stage, microbatched over the leading (batch) axis.
+
+    ``stacked_params`` has a leading stage axis of size P (see
+    :func:`pipeline_stage_params`); it is consumed sharded over ``axis``.
+    Returns the output batch, replicated (identical on every pipeline rank).
+    """
+    n_stages = mesh.shape[axis]
+    m = n_microbatches or n_stages
+    batch = x.shape[0]
+    if batch % m:
+        raise ValueError(f"batch {batch} not divisible by {m} microbatches")
+    micro = x.reshape(m, batch // m, *x.shape[1:])
+
+    def kernel(p, xm):
+        p = jax.tree.map(lambda a: a[0], p)  # this device's stage
+        stage = jax.lax.axis_index(axis)
+        fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def body(t, carry):
+            buf, outs = carry
+            # stage 0 ingests microbatch t while it exists; later stages
+            # consume what the previous stage sent last tick
+            idx = jnp.clip(t, 0, m - 1)
+            inp = jnp.where(stage == 0, xm[idx], buf)
+            out = stage_fn(p, inp)
+            emit_t = t - (n_stages - 1)
+            is_emit = (stage == n_stages - 1) & (emit_t >= 0)
+            outs = jnp.where(
+                is_emit,
+                outs.at[jnp.clip(emit_t, 0, m - 1)].set(out),
+                outs,
+            )
+            buf = jax.lax.ppermute(out, axis, fwd)
+            return buf, outs
+
+        buf0 = jnp.zeros_like(xm[0])
+        outs0 = jnp.zeros(xm.shape, xm.dtype)
+        _, outs = jax.lax.fori_loop(0, m + n_stages - 1, body, (buf0, outs0))
+        # only the last stage holds real outputs; psum replicates them
+        outs = jax.lax.psum(
+            jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs)), axis
+        )
+        return outs
+
+    out = jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=(P(axis), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )(stacked_params, micro)
+    return out.reshape(batch, *out.shape[2:])

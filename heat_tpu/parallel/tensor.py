@@ -1,0 +1,110 @@
+"""Tensor (model) parallelism: Megatron-style sharded Dense layers.
+
+The reference has no TP (SURVEY.md §2.3 marks it absent); this is the
+TPU-native extension. Nothing here hand-schedules communication: the kernels
+carry ``PartitionSpec`` annotations (flax ``with_partitioning`` metadata) and
+the activations receive ``with_sharding_constraint``s; GSPMD inserts the
+all-gather / reduce-scatter pair that realizes the Megatron column→row
+pattern, overlapped by XLA's latency-hiding scheduler.
+
+Axis conventions: 'tp' = tensor axis, 'dp' = data axis (batch). Use
+:func:`heat_tpu.parallel.make_mesh` to build the mesh and run the module
+under ``jax.jit`` inside ``with mesh:`` (or pass shardings explicitly).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ColumnParallelDense", "RowParallelDense", "TPMLPBlock"]
+
+
+def _constrain_last(x, axis_name):
+    """Constrain only the feature (last) dim; leading dims (batch/seq) keep
+    whatever sharding the data came with (UNCONSTRAINED), so a dp-sharded
+    batch is not gathered. No-op outside a mesh context."""
+    spec = P(*([P.UNCONSTRAINED] * (x.ndim - 1)), axis_name)
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except Exception:
+        return x
+
+
+class ColumnParallelDense(nn.Module):
+    """Dense whose kernel is column-sharded over 'tp': y[..., f] with f
+    partitioned. The activation stays tp-sharded — feed it to a
+    :class:`RowParallelDense` to contract it back (the Megatron pair)."""
+
+    features: int
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    tp_axis: str = "tp"
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(self.kernel_init, (None, self.tp_axis)),
+            (x.shape[-1], self.features),
+            self.dtype or x.dtype,
+        )
+        y = x @ kernel
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                nn.with_partitioning(nn.initializers.zeros_init(), (self.tp_axis,)),
+                (self.features,),
+                self.dtype or x.dtype,
+            )
+            y = y + bias
+        return _constrain_last(y, self.tp_axis)
+
+
+class RowParallelDense(nn.Module):
+    """Dense whose kernel is row-sharded over 'tp': contracts a tp-sharded
+    input; GSPMD inserts the psum (all-reduce) over 'tp' for the partial
+    products. Output is replicated across 'tp'."""
+
+    features: int
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    tp_axis: str = "tp"
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(self.kernel_init, (self.tp_axis, None)),
+            (x.shape[-1], self.features),
+            self.dtype or x.dtype,
+        )
+        y = x @ kernel
+        if self.use_bias:
+            # bias is added once, after the implicit psum — replicated
+            bias = self.param(
+                "bias", nn.initializers.zeros_init(), (self.features,), self.dtype or x.dtype
+            )
+            y = y + bias
+        return _constrain_last(y, None)
+
+
+class TPMLPBlock(nn.Module):
+    """The canonical 2-layer TP block: column-parallel up-projection, gelu,
+    row-parallel down-projection. One all-reduce per block, like Megatron."""
+
+    hidden: int
+    features: int
+    tp_axis: str = "tp"
+
+    @nn.compact
+    def __call__(self, x):
+        h = ColumnParallelDense(self.hidden, tp_axis=self.tp_axis, name="up")(x)
+        h = nn.gelu(h)
+        return RowParallelDense(self.features, tp_axis=self.tp_axis, name="down")(h)

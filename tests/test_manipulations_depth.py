@@ -1,0 +1,97 @@
+"""Manipulations kwarg/edge coverage (model: reference test_manipulations.py,
+the largest test file in the reference at ~3.6k LoC): secondary keyword
+arguments and less-traveled paths, all against numpy oracles on sharded inputs.
+"""
+
+import numpy as np
+
+import heat_tpu as ht
+from harness import TestCase
+
+rng = np.random.default_rng(13)
+X = rng.integers(0, 6, (12, 5))
+
+
+class TestUniqueSortTopk(TestCase):
+    def test_unique_return_inverse(self):
+        a = ht.array(X.ravel(), split=0)
+        u, inv = ht.unique(a, return_inverse=True)
+        np.testing.assert_array_equal(np.sort(u.numpy()), np.unique(X.ravel()))
+        np.testing.assert_array_equal(u.numpy()[inv.numpy()], X.ravel())
+
+    def test_sort_descending(self):
+        a = ht.array(X.astype(float), split=0)
+        v, i = ht.sort(a, axis=0, descending=True)
+        np.testing.assert_array_equal(v.numpy(), -np.sort(-X.astype(float), axis=0))
+
+    def test_topk_smallest(self):
+        a = ht.array(X.astype(float), split=0)
+        v, i = ht.topk(a, 3, dim=0, largest=False)
+        np.testing.assert_array_equal(v.numpy(), np.sort(X.astype(float), axis=0)[:3])
+
+
+class TestPadRepeatTile(TestCase):
+    def test_pad_constant_values(self):
+        a = ht.array(X.astype(float), split=0)
+        np.testing.assert_array_equal(
+            ht.pad(a, ((1, 1), (2, 0)), constant_values=7).numpy(),
+            np.pad(X.astype(float), ((1, 1), (2, 0)), constant_values=7),
+        )
+
+    def test_repeat_tile(self):
+        a = ht.array(X, split=0)
+        np.testing.assert_array_equal(ht.repeat(a, 3, axis=1).numpy(), np.repeat(X, 3, 1))
+        np.testing.assert_array_equal(ht.repeat(a, 2, axis=0).numpy(), np.repeat(X, 2, 0))
+        np.testing.assert_array_equal(ht.tile(a, (2, 3)).numpy(), np.tile(X, (2, 3)))
+
+
+class TestSplitStackDiag(TestCase):
+    def test_vsplit_hsplit(self):
+        a = ht.array(X.astype(float), split=0)
+        for p, npp in zip(ht.vsplit(a, [4]), np.vsplit(X.astype(float), [4])):
+            np.testing.assert_array_equal(p.numpy(), npp)
+        for p, npp in zip(ht.hsplit(a, [2]), np.hsplit(X.astype(float), [2])):
+            np.testing.assert_array_equal(p.numpy(), npp)
+
+    def test_column_row_stack(self):
+        a1, a2 = rng.standard_normal(5), rng.standard_normal(5)
+        np.testing.assert_array_equal(
+            ht.column_stack([ht.array(a1, split=0), ht.array(a2, split=0)]).numpy(),
+            np.column_stack([a1, a2]),
+        )
+        np.testing.assert_array_equal(
+            ht.row_stack([ht.array(a1, split=0), ht.array(a2, split=0)]).numpy(),
+            np.vstack([a1, a2]),
+        )
+
+    def test_diag_offset(self):
+        # the reference spells numpy's k= as offset= (reference manipulations.py:512)
+        v = rng.standard_normal(6)
+        np.testing.assert_array_equal(ht.diag(ht.array(v, split=0)).numpy(), np.diag(v))
+        np.testing.assert_array_equal(
+            ht.diag(ht.array(v, split=0), offset=1).numpy(), np.diag(v, 1)
+        )
+        m = rng.standard_normal((5, 5))
+        np.testing.assert_array_equal(
+            ht.diagonal(ht.array(m, split=0), offset=-1).numpy(), np.diagonal(m, -1)
+        )
+
+
+class TestIndexingEdge(TestCase):
+    def test_nonzero_where(self):
+        m = rng.standard_normal((6, 4))
+        a = ht.array(m, split=0)
+        np.testing.assert_array_equal(
+            ht.nonzero(a > 0).numpy(), np.transpose(np.nonzero(m > 0))
+        )
+        np.testing.assert_array_equal(
+            ht.where(a > 0, a, -a).numpy(), np.where(m > 0, m, -m)
+        )
+
+    def test_bucketize_digitize(self):
+        v = rng.standard_normal(20)
+        bounds = np.array([-1.0, 0.0, 1.0])
+        np.testing.assert_array_equal(
+            ht.bucketize(ht.array(v, split=0), ht.array(bounds)).numpy(),
+            np.digitize(v, bounds),
+        )

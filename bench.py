@@ -1,59 +1,134 @@
 """Benchmark entry point (driver contract: prints ONE JSON line).
 
-Tracked config 3 of BASELINE.md: kmeans, k=8 on 10M×16 float32, split=0.
-The metric is Lloyd iterations/second on the available chip(s); vs_baseline
-is the speedup over a torch-CPU implementation of the same iteration measured
-on the same machine (the reference's single-node comparison baseline,
-reference benchmarks/kmeans/{heat,torch}-cpu.py — no absolute numbers are
-published in the reference repo, see BASELINE.md).
+Tracked configs of BASELINE.md measured here:
+  * config 3 (primary metric): kmeans, k=8 on 10M x 16 float32, split=0 —
+    Lloyd iterations/second.
+  * config 2 (extra field): cdist (quadratic expansion) GB/s/chip.
+  * achieved TFLOP/s of the fused Lloyd iteration (extra field).
+
+``vs_baseline`` is the measured speedup over a torch-CPU implementation of
+the same Lloyd iteration at the FULL problem size on this machine (the
+reference's single-node comparison baseline, reference
+benchmarks/kmeans/{heat,torch}-cpu.py — the reference repo publishes no
+absolute numbers, see BASELINE.md).
+
+Robustness: the measurement runs in a child process. The parent retries the
+default (TPU) backend with exponential backoff; if it stays unavailable it
+falls back to JAX_PLATFORMS=cpu at reduced size, and if everything fails it
+still emits the JSON line with an "error" field — a transient backend error
+must never produce an empty perf record again (round-1 failure mode).
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+METRIC = "kmeans_iters_per_sec_10Mx16_k8"
 
+# full-size problem (TPU); the CPU fallback shrinks N by x10 and reports the
+# platform so the number is never silently compared across backends
 N, F, K = 10_000_000, 16, 8
 ITERS = 10
+CDIST_N, CDIST_F = 32768, 64
 
 
-def bench_heat_tpu() -> float:
+def _flops_per_lloyd_iter(n: int) -> float:
+    # assignment matmul (2nFK) + one-hot update matmul (2nKF) + O(nK) argmin etc.
+    return 2.0 * n * F * K * 2 + 10.0 * n * K
+
+
+def worker() -> None:
     import jax
+
+    if os.environ.get("HEAT_BENCH_PLATFORM"):
+        # the axon site hook forces jax_platforms="axon,cpu" at import time,
+        # overriding the JAX_PLATFORMS env var — only a config update after
+        # import actually selects the CPU backend
+        jax.config.update("jax_platforms", os.environ["HEAT_BENCH_PLATFORM"])
+    import jax.numpy as jnp
+    import numpy as np
 
     import heat_tpu as ht
     from heat_tpu.cluster.kmeans import _lloyd_run
 
     comm = ht.get_comm()
-    n = (N // comm.size) * comm.size
-    rng = np.random.default_rng(0)
-    centers0 = rng.standard_normal((K, F)).astype(np.float32) * 3
-    # generate data on device to skip a 640MB host transfer
-    import jax.numpy as jnp
+    platform = comm.devices[0].platform
+    on_accel = platform not in ("cpu",)
+    n = N if on_accel else N // 10
+    n = (n // comm.size) * comm.size
+    cd_n = CDIST_N if on_accel else 4096
 
+    rng = np.random.default_rng(0)
+    centers = jnp.asarray(rng.standard_normal((K, F)).astype(np.float32) * 3)
     data = jax.device_put(
         jax.random.normal(jax.random.PRNGKey(1), (n, F), dtype=jnp.float32),
         comm.sharding(2, 0),
     )
-    centers = jnp.asarray(centers0)
+
+    # -- kmeans (primary) --------------------------------------------------
     # warmup/compile (fused ITERS-step program, one dispatch); synchronize via
     # a scalar host read — block_until_ready is unreliable on the axon backend
-    c, lab, inertia, shift = _lloyd_run(data, centers, K, ITERS)
+    _, _, _, shift = _lloyd_run(data, centers, K, ITERS)
     float(shift)
     best = float("inf")
     for _ in range(3):
         start = time.perf_counter()
-        centers2, lab, inertia, shift = _lloyd_run(data, centers, K, ITERS)
+        _, _, _, shift = _lloyd_run(data, centers, K, ITERS)
         float(shift)
         best = min(best, time.perf_counter() - start)
-    return ITERS / best
+    iters_per_sec = ITERS / best
+    lloyd_tflops = _flops_per_lloyd_iter(n) * iters_per_sec / 1e12
+
+    # -- cdist GB/s/chip (config 2) ---------------------------------------
+    from heat_tpu.spatial.distance import _euclidian_fast
+
+    x = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(2), (cd_n, CDIST_F), dtype=jnp.float32),
+        comm.sharding(2, 0),
+    )
+    cfn = jax.jit(_euclidian_fast)
+    out = cfn(x, x)
+    float(out[0, 0])
+    cd_best = float("inf")
+    for _ in range(3):
+        start = time.perf_counter()
+        out = cfn(x, x)
+        float(out[0, 0])
+        cd_best = min(cd_best, time.perf_counter() - start)
+    # bytes that must cross HBM at minimum: read both operands once, write the
+    # full (n, n) float32 result
+    cd_bytes = 2 * cd_n * CDIST_F * 4 + cd_n * cd_n * 4
+    cd_gbps = cd_bytes / cd_best / 1e9 / comm.size
+
+    # -- torch-CPU baseline, measured at the same n (not extrapolated) -----
+    try:
+        vs = iters_per_sec / _torch_cpu_iters_per_sec(n)
+    except Exception:
+        vs = float("nan")
+
+    print(
+        json.dumps(
+            {
+                "metric": METRIC,
+                "value": round(iters_per_sec, 3),
+                "unit": "iters/s",
+                "vs_baseline": round(vs, 2),
+                "platform": platform,
+                "n": n,
+                "lloyd_tflops": round(lloyd_tflops, 3),
+                "cdist_gbps_per_chip": round(cd_gbps, 2),
+                "cdist_n": cd_n,
+            }
+        )
+    )
 
 
-def bench_torch_cpu(iters: int = 2) -> float:
+def _torch_cpu_iters_per_sec(n: int, iters: int = 2) -> float:
     import torch
 
     torch.manual_seed(1)
-    scale = 10  # run the torch baseline on N/scale points, rate scales linearly
-    n = N // scale
     data = torch.randn(n, F)
     centers = torch.randn(K, F) * 3
 
@@ -69,24 +144,85 @@ def bench_torch_cpu(iters: int = 2) -> float:
     start = time.perf_counter()
     for _ in range(iters):
         centers = step(data, centers)
-    elapsed = time.perf_counter() - start
-    return iters / elapsed / scale  # iters/sec at full N
+    return iters / (time.perf_counter() - start)
 
 
-def main():
-    ours = bench_heat_tpu()
+def _try_once(env: dict, timeout: float) -> tuple:
+    """Run the worker in a child process; return (json_line or None, err_tail)."""
     try:
-        baseline = bench_torch_cpu()
-        vs = ours / baseline if baseline > 0 else float("nan")
-    except Exception:
-        vs = float("nan")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--_worker"],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"worker timed out after {timeout}s"
+    except Exception as exc:  # noqa: BLE001
+        return None, repr(exc)
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except (ValueError, TypeError):
+            continue
+        if isinstance(rec, dict) and rec.get("metric") == METRIC:
+            return line, ""
+    return None, (proc.stderr or proc.stdout or "no output")[-2000:]
+
+
+def _probe_backend(env: dict, timeout: float = 180.0) -> bool:
+    """Cheap child-process check that jax.devices() comes up at all — the
+    axon backend can hang for minutes when the tunnel is down, and burning
+    the full measurement timeout on that costs the whole bench window."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            env=env,
+            capture_output=True,
+            timeout=timeout,
+        )
+        return proc.returncode == 0
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def main() -> None:
+    if "--_worker" in sys.argv:
+        worker()
+        return
+
+    last_err = ""
+    # 1) default backend (TPU when available), with retry + backoff — the
+    #    round-1 failure was a transient UNAVAILABLE from the axon backend
+    for attempt in range(3):
+        if attempt:
+            time.sleep(15 * attempt)
+        if not _probe_backend(os.environ.copy()):
+            last_err = "backend probe failed (jax.devices() unavailable or hung)"
+            continue
+        line, err = _try_once(os.environ.copy(), timeout=1500)
+        if line:
+            print(line)
+            return
+        last_err = err
+    # 2) CPU fallback — a degraded number beats an empty record. (The axon
+    #    site hook overrides the JAX_PLATFORMS env var, so the worker applies
+    #    this choice via jax.config after import.)
+    env = os.environ.copy()
+    env["HEAT_BENCH_PLATFORM"] = "cpu"
+    line, err = _try_once(env, timeout=1500)
+    if line:
+        print(line)
+        return
     print(
         json.dumps(
             {
-                "metric": "kmeans_iters_per_sec_10Mx16_k8",
-                "value": round(ours, 3),
+                "metric": METRIC,
+                "value": None,
                 "unit": "iters/s",
-                "vs_baseline": round(vs, 2),
+                "vs_baseline": None,
+                "error": (err or last_err)[-800:],
             }
         )
     )

@@ -29,10 +29,12 @@ reference scripts branch on.
 
 from __future__ import annotations
 
+import functools
 import os
-from typing import List, Optional, Sequence, Tuple, Union
+from typing import Callable, List, Optional, Sequence, Tuple, Union
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -49,6 +51,155 @@ __all__ = [
 ]
 
 SPLIT_AXIS = "split"
+
+
+def _type_min(dtype):
+    """Most-negative representable value (neutral element of max)."""
+    if jnp.issubdtype(dtype, jnp.floating):
+        return -jnp.inf
+    if jnp.issubdtype(dtype, jnp.bool_):
+        return False
+    return jnp.iinfo(dtype).min
+
+
+def _type_max(dtype):
+    """Most-positive representable value (neutral element of min)."""
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.inf
+    if jnp.issubdtype(dtype, jnp.bool_):
+        return True
+    return jnp.iinfo(dtype).max
+
+
+# ----------------------------------------------------------------------------
+# in-kernel collective functions (call inside shard_map over a mesh axis)
+#
+# The XLA rendering of the reference's MPI collective set
+# (reference communication.py:88-1891): string ops lower to hardware
+# collectives over ICI; a callable ``op`` — the analog of a custom MPI reduce
+# op (reference statistics.py:1335-1405, manipulations.py:3985-4028) — is an
+# associative pytree combiner evaluated as an all_gather + static fold.
+# ----------------------------------------------------------------------------
+def _neutral(op: str, x):
+    makers = {
+        "sum": lambda l: jnp.zeros_like(l),
+        "prod": lambda l: jnp.ones_like(l),
+        "max": lambda l: jnp.full_like(l, _type_min(l.dtype)),
+        "min": lambda l: jnp.full_like(l, _type_max(l.dtype)),
+        "land": lambda l: jnp.ones_like(l),
+        "lor": lambda l: jnp.zeros_like(l),
+    }
+    return jax.tree.map(makers[op], x)
+
+
+def _combine(op: Union[str, Callable]) -> Callable:
+    """Binary pytree combiner for a string or callable ``op``."""
+    if callable(op):
+        return op
+    fns = {
+        "sum": jnp.add,
+        "prod": jnp.multiply,
+        "max": jnp.maximum,
+        "min": jnp.minimum,
+        "land": jnp.logical_and,
+        "lor": jnp.logical_or,
+    }
+    fn = fns[op]
+    return lambda a, b: jax.tree.map(fn, a, b)
+
+
+def allreduce(x, axis: str, op: Union[str, Callable] = "sum", size: Optional[int] = None):
+    """All-reduce ``x`` over mesh axis ``axis`` (reference Allreduce)."""
+    if op == "sum":
+        return jax.tree.map(lambda l: jax.lax.psum(l, axis), x)
+    if op == "mean":
+        return jax.tree.map(lambda l: jax.lax.pmean(l, axis), x)
+    if op == "max":
+        return jax.tree.map(lambda l: jax.lax.pmax(l, axis), x)
+    if op == "min":
+        return jax.tree.map(lambda l: jax.lax.pmin(l, axis), x)
+    if op == "land":
+        return jax.tree.map(lambda l: jax.lax.pmin(l.astype(jnp.uint8), axis).astype(jnp.bool_), x)
+    if op == "lor":
+        return jax.tree.map(lambda l: jax.lax.pmax(l.astype(jnp.uint8), axis).astype(jnp.bool_), x)
+    # prod / custom combiner: gather the contributions (size is static) and
+    # fold — the XLA rendering of an arbitrary MPI reduce op
+    if size is None:
+        raise ValueError("custom/prod allreduce needs the static axis size")
+    combine = _combine(op)
+    gathered = jax.tree.map(lambda l: jax.lax.all_gather(l, axis), x)
+    acc = jax.tree.map(lambda g: g[0], gathered)
+    for i in range(1, size):
+        acc = combine(acc, jax.tree.map(lambda g: g[i], gathered))
+    return acc
+
+
+def allgather(x, axis: str, gather_axis: int = 0, tiled: bool = False):
+    """All-gather over the mesh axis (reference Allgather(v)).
+    ``tiled=False`` stacks a new axis at position ``gather_axis``;
+    ``tiled=True`` concatenates along it."""
+    return jax.tree.map(lambda l: jax.lax.all_gather(l, axis, axis=gather_axis, tiled=tiled), x)
+
+
+def alltoall(x, axis: str, split_axis: int = 0, concat_axis: int = 0):
+    """All-to-all over the mesh axis (reference Alltoall(v/w)): scatter
+    ``split_axis``, concatenate received pieces along ``concat_axis``."""
+    return jax.tree.map(
+        lambda l: jax.lax.all_to_all(l, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True),
+        x,
+    )
+
+
+def ppermute(
+    x,
+    axis: str,
+    size: int,
+    shift: int = 1,
+    perm: Optional[Sequence[Tuple[int, int]]] = None,
+):
+    """Ring rotation: device ``d`` receives device ``(d + shift) % size``'s
+    value; an explicit ``perm`` of (src, dst) pairs overrides ``shift``."""
+    if perm is None:
+        perm = [(j, (j - shift) % size) for j in range(size)]
+    return jax.tree.map(lambda l: jax.lax.ppermute(l, axis, perm), x)
+
+
+def bcast(x, axis: str, root: int = 0):
+    """Every device gets ``root``'s value — a masked psum: O(1) memory, no
+    gather (reference Bcast, communication.py:544-600)."""
+    idx = jax.lax.axis_index(axis)
+
+    def pick(l):
+        numeric = l if jnp.issubdtype(l.dtype, jnp.number) else l.astype(jnp.uint8)
+        masked = jnp.where(idx == root, numeric, jnp.zeros_like(numeric))
+        out = jax.lax.psum(masked, axis)
+        return out if numeric.dtype == l.dtype else out.astype(l.dtype)
+
+    return jax.tree.map(pick, x)
+
+
+def exscan(x, axis: str, size: int, op: Union[str, Callable] = "sum", neutral=None):
+    """Exclusive prefix combine over the device axis (reference Exscan,
+    the cumsum/cumprod workhorse _operations.py:268-295). Device 0 gets the
+    neutral element."""
+    idx = jax.lax.axis_index(axis)
+    if neutral is None:
+        if callable(op):
+            raise ValueError("a callable op requires an explicit neutral element")
+        neutral = _neutral(op, x)
+    combine = _combine(op)
+    gathered = jax.tree.map(lambda l: jax.lax.all_gather(l, axis), x)
+    out = acc = neutral
+    # size is static: unrolled fold; device d keeps the prefix of shards < d
+    for i in range(size - 1):
+        acc = combine(acc, jax.tree.map(lambda g: g[i], gathered))
+        out = jax.tree.map(lambda o, a: jnp.where(idx > i, a, o), out, acc)
+    return out
+
+
+def pscan(x, axis: str, size: int, op: Union[str, Callable] = "sum", neutral=None):
+    """Inclusive prefix combine over the device axis (reference Scan)."""
+    return _combine(op)(exscan(x, axis, size, op, neutral), x)
 
 
 class Communication:
@@ -161,6 +312,96 @@ class MeshCommunication(Communication):
             _, lshape, _ = self.chunk(shape, split, rank=r)
             out[r] = lshape
         return out
+
+    # ------------------------------------------------------------------
+    # collective helpers (reference communication.py:88-1891)
+    #
+    # These are the chokepoint the reference's MPICommunication provides:
+    # every explicitly-scheduled algorithm (ring cdist, TSQR, DASO, ring/
+    # Ulysses attention, pipeline) routes its bytes through them. They are
+    # *in-kernel* helpers — call them inside a ``shard_map`` over ``.mesh``
+    # (use :meth:`apply` to enter one); each receives the per-device shard
+    # view and lowers to a single XLA collective over the mesh axis. The
+    # implementations are the module-level functions below, which take an
+    # explicit (axis, size) so kernels on other meshes (DASO's 2-axis
+    # dcn×ici, the tp/pp/ep meshes) share the same code path.
+    # ------------------------------------------------------------------
+    def allreduce(self, x, op: Union[str, Callable] = "sum"):
+        """Combine ``x`` across all devices; every device gets the result
+        (reference Allreduce, communication.py:712-760). ``op`` ∈
+        {'sum','mean','prod','max','min','land','lor'} or an associative
+        callable combining two pytrees (custom-MPI-op analog, reference
+        statistics.py:1335-1405)."""
+        return allreduce(x, self.axis_name, op, self.size)
+
+    def allgather(self, x, gather_axis: int = 0, tiled: bool = False):
+        """Gather every device's shard to all devices (reference Allgather(v),
+        communication.py:790-900). ``tiled=False`` stacks a new device axis at
+        ``gather_axis``; ``tiled=True`` concatenates along it."""
+        return allgather(x, self.axis_name, gather_axis=gather_axis, tiled=tiled)
+
+    def alltoall(self, x, split_axis: int = 0, concat_axis: int = 0):
+        """Transpose the device axis against a data axis (reference
+        Alltoall(v/w), communication.py:336-437)."""
+        return alltoall(x, self.axis_name, split_axis=split_axis, concat_axis=concat_axis)
+
+    def ppermute(self, x, shift: int = 1, perm: Optional[Sequence[Tuple[int, int]]] = None):
+        """Ring rotation: device ``d`` receives the shard of device
+        ``(d + shift) % p`` (the Send-to-neighbor schedule of reference
+        distance.py:272-327 / get_halo dndarray.py:360-441)."""
+        return ppermute(x, self.axis_name, self.size, shift=shift, perm=perm)
+
+    def bcast(self, x, root: int = 0):
+        """Every device gets ``root``'s shard (reference Bcast,
+        communication.py:544-600)."""
+        return bcast(x, self.axis_name, root)
+
+    def exscan(self, x, op: Union[str, Callable] = "sum", neutral=None):
+        """Exclusive prefix combine over the device axis (reference Exscan,
+        communication.py:1160-1220); device 0 gets the neutral element."""
+        return exscan(x, self.axis_name, self.size, op, neutral)
+
+    def scan(self, x, op: Union[str, Callable] = "sum", neutral=None):
+        """Inclusive prefix combine over the device axis (reference Scan)."""
+        return pscan(x, self.axis_name, self.size, op, neutral)
+
+    def apply(
+        self,
+        kernel: Callable,
+        *arrays,
+        in_splits: Sequence[Optional[int]],
+        out_splits: Union[Optional[int], Sequence[Optional[int]]],
+        check_vma: bool = False,
+    ):
+        """Run ``kernel`` as a jitted ``shard_map`` over this mesh.
+
+        ``kernel`` sees per-device shards and may call the collective helpers
+        above. ``in_splits[i]``/``out_splits[j]`` give the dimension each
+        array is block-split along (None = replicated) — the same vocabulary
+        as ``DNDarray.split``.
+        """
+        def prefix_spec(split):
+            # PartitionSpec may be shorter than the array rank (trailing dims
+            # are implicitly unsharded), so the split position suffices
+            if split is None:
+                return PartitionSpec()
+            return PartitionSpec(*([None] * split), self.axis_name)
+
+        in_specs = tuple(self.spec(a.ndim, s) for a, s in zip(arrays, in_splits))
+        if isinstance(out_splits, (tuple, list)):
+            out_specs = tuple(prefix_spec(s) for s in out_splits)
+        else:
+            out_specs = prefix_spec(out_splits)
+        fn = jax.jit(
+            jax.shard_map(
+                kernel,
+                mesh=self.mesh,
+                in_specs=in_specs,
+                out_specs=out_specs,
+                check_vma=check_vma,
+            )
+        )
+        return fn(*arrays)
 
     # ------------------------------------------------------------------
     # group creation (reference communication.py:445-456)

@@ -424,13 +424,31 @@ class DNDarray:
         return key
 
     def _result_split(self, key) -> Optional[int]:
-        """Split of an indexing result: follow what happens to the split dim."""
+        """Split of an indexing result: follow what happens to the split dim.
+
+        Advanced (boolean-mask / integer-array) keys keep the distribution
+        (reference dndarray.py:652-908 translates them globally; here the
+        gather output is re-constrained to ``split``): with a single advanced
+        key the result's advanced block lands in place — if it consumed the
+        split dimension the result is split along the block's first output
+        dim, otherwise the split dim's new position is tracked through the
+        key. Multiple advanced keys (numpy moves the block to the front, and
+        combining them permutes data across devices unpredictably) degrade to
+        replicated.
+        """
         if self.__split is None:
             return None
         key_t = key if isinstance(key, tuple) else (key,)
         # expand Ellipsis
         if any(k is Ellipsis for k in key_t):
-            n_explicit = sum(1 for k in key_t if k is not Ellipsis and k is not None)
+            n_explicit = 0
+            for k in key_t:
+                if k is Ellipsis or k is None:
+                    continue
+                if _is_advanced_key(k) and _key_dtype_is_bool(k):
+                    n_explicit += _key_ndim(k)
+                else:
+                    n_explicit += 1
             expanded: list = []
             for k in key_t:
                 if k is Ellipsis:
@@ -438,29 +456,35 @@ class DNDarray:
                 else:
                     expanded.append(k)
             key_t = tuple(expanded)
+
+        advanced = [k for k in key_t if _is_advanced_key(k)]
+        if len(advanced) > 1:
+            return None  # numpy front-moves the block; distribution undefined
         out_dim = 0
         in_dim = 0
-        saw_advanced = any(
-            isinstance(k, (list, np.ndarray, jax.Array)) or hasattr(k, "split") for k in key_t
-        )
         for k in key_t:
             if k is None:
                 out_dim += 1
                 continue
+            if _is_advanced_key(k):
+                is_bool = _key_dtype_is_bool(k)
+                consumed = _key_ndim(k) if is_bool else 1
+                produced = 1 if is_bool else _key_ndim(k)
+                if in_dim <= self.__split < in_dim + consumed:
+                    # the advanced block consumed the split dim: shard the
+                    # block's first result dim (0-D int keys drop the dim)
+                    return out_dim if produced > 0 else None
+                in_dim += consumed
+                out_dim += produced
+                continue
             if in_dim == self.__split:
-                if isinstance(k, slice):
-                    return None if saw_advanced else out_dim
-                return None  # int or advanced index consumes/permutes the split dim
+                return out_dim if isinstance(k, slice) else None
             if isinstance(k, (int, np.integer)):
                 in_dim += 1
-            elif isinstance(k, slice):
+            else:  # slice
                 in_dim += 1
                 out_dim += 1
-            else:  # advanced index — result layout is data-dependent
-                return None
         # split dim untouched by the key: shift by dropped/inserted dims before it
-        if saw_advanced:
-            return None
         return out_dim + (self.__split - in_dim)
 
     def __getitem__(self, key) -> "DNDarray":
@@ -666,17 +690,46 @@ class DNDarray:
     __str__ = __repr__
 
 
+def _is_advanced_key(k) -> bool:
+    """True for boolean-mask / integer-array index components (DNDarray,
+    numpy / jax arrays, or list keys — numpy fancy-index semantics)."""
+    return isinstance(k, (list, np.ndarray, jax.Array)) or isinstance(k, DNDarray)
+
+
+def _key_dtype_is_bool(k) -> bool:
+    if isinstance(k, DNDarray):
+        return k.larray.dtype == jnp.bool_
+    if isinstance(k, list):
+        return len(k) > 0 and isinstance(k[0], (bool, np.bool_))
+    return np.asarray(k).dtype == np.bool_ if isinstance(k, np.ndarray) else k.dtype == jnp.bool_
+
+
+def _key_ndim(k) -> int:
+    if isinstance(k, DNDarray):
+        return k.ndim
+    if isinstance(k, list):
+        return np.asarray(k).ndim
+    return k.ndim
+
+
 def _ensure_split(array: jax.Array, split: Optional[int], comm: MeshCommunication) -> jax.Array:
     """Place ``array`` under the sharding implied by ``split`` if it is not
     already there. Eager resharding is one ``device_put`` (XLA collective).
 
-    Dimensions not divisible by the mesh size cannot carry an exact 8-way
-    NamedSharding in JAX; those arrays are placed via a jitted
-    ``with_sharding_constraint`` and GSPMD picks the closest representable
-    layout (correctness unaffected; see SURVEY.md §7 ragged-semantics stance).
+    Dimensions not divisible by the mesh size cannot carry a NamedSharding at
+    all in JAX (device_put/out_shardings/make_array_from_callback all reject
+    them), so a ragged ``split`` is *logical only*: the array keeps its
+    current physical placement (typically replicated) and ``split`` records
+    the intended distribution, which the next divisible-shape op restores.
+    This is the SURVEY.md §7 "balanced-only fast path" stance — the reference
+    itself prefers balanced arrays and carries ragged ones as metadata
+    (reference dndarray.py:57-60). The behavior is pinned by
+    tests/test_indexing_advanced.py and tests/test_edge_behaviors.py.
     """
     if array.ndim == 0:
         split = None
+    if split is not None and array.shape[split] % comm.size != 0:
+        return array  # ragged: logical split only, no representable layout
     target = comm.sharding(array.ndim, split)
     current = getattr(array, "sharding", None)
     if current is not None:
@@ -685,6 +738,4 @@ def _ensure_split(array: jax.Array, split: Optional[int], comm: MeshCommunicatio
                 return array
         except Exception:
             pass
-    if split is None or array.shape[split] % comm.size == 0:
-        return jax.device_put(array, target)
-    return jax.jit(lambda a: jax.lax.with_sharding_constraint(a, target))(array)
+    return jax.device_put(array, target)

@@ -102,7 +102,7 @@ def _tsqr(x: jax.Array, comm) -> Tuple[jax.Array, jax.Array]:
 
     def kernel(xs):
         q1, r1 = jnp.linalg.qr(xs, mode="reduced")  # (m/p, n), (n, n)
-        rs = jax.lax.all_gather(r1, axis)  # (p, n, n)
+        rs = comm.allgather(r1)  # (p, n, n) — one ICI collective
         q2, r = jnp.linalg.qr(rs.reshape(p * n, n), mode="reduced")
         idx = jax.lax.axis_index(axis)
         q2_block = jax.lax.dynamic_slice_in_dim(q2, idx * n, n, axis=0)  # (n, n)

@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..core import communication as comm_lib
+
 __all__ = ["MoELayer", "moe_apply"]
 
 
@@ -54,12 +56,12 @@ def moe_apply(
         dispatch = dispatch.reshape(n_exp, t, xs.shape[1])
 
         # exchange: block j goes to device j; we receive one block per source
-        received = jax.lax.all_to_all(dispatch, axis, split_axis=0, concat_axis=0)
+        received = comm_lib.alltoall(dispatch, axis, split_axis=0, concat_axis=0)
         flat = received.reshape(n_exp * t, xs.shape[1])
         transformed = expert_fn(p, flat).reshape(n_exp, t, xs.shape[1])
 
         # return trip and unpack to original token order
-        back = jax.lax.all_to_all(transformed, axis, split_axis=0, concat_axis=0)
+        back = comm_lib.alltoall(transformed, axis, split_axis=0, concat_axis=0)
         out = back.reshape(n_exp * t, xs.shape[1])[slot]
         return out * gate[:, None]
 

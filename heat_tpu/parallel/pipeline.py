@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..core import communication as comm_lib
+
 __all__ = ["pipeline_apply", "pipeline_stage_params"]
 
 
@@ -69,15 +71,15 @@ def pipeline_apply(
                 outs.at[jnp.clip(emit_t, 0, m - 1)].set(out),
                 outs,
             )
-            buf = jax.lax.ppermute(out, axis, fwd)
+            buf = comm_lib.ppermute(out, axis, n_stages, perm=fwd)
             return buf, outs
 
         buf0 = jnp.zeros_like(xm[0])
         outs0 = jnp.zeros(xm.shape, xm.dtype)
         _, outs = jax.lax.fori_loop(0, m + n_stages - 1, body, (buf0, outs0))
-        # only the last stage holds real outputs; psum replicates them
-        outs = jax.lax.psum(
-            jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs)), axis
+        # only the last stage holds real outputs; the sum-bcast replicates them
+        outs = comm_lib.allreduce(
+            jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs)), axis, "sum"
         )
         return outs
 

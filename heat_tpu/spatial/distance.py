@@ -114,15 +114,28 @@ def _dist(X: DNDarray, Y: Optional[DNDarray], metric: Callable) -> DNDarray:
     n, m = xl.shape[0], yl.shape[0]
     p = comm.size
 
-    use_ring = (
-        X.split == 0
-        and y_split == 0
-        and p > 1
-        and n % p == 0
-        and m % p == 0
-    )
+    use_ring = X.split == 0 and y_split == 0 and p > 1
     if use_ring:
-        result = _ring_dist(xl, yl, metric, comm)
+        symmetric = Y is None or Y is X
+        # ragged row counts: pad to the next multiple of p and slice the
+        # result — the reference's *v collectives have no XLA analog
+        # (SURVEY.md §7), pad+mask is the balanced-only rendering
+        n_pad, m_pad = (-n) % p, (-m) % p
+        if symmetric and n_pad:
+            xl = yl = jnp.pad(xl, ((0, n_pad), (0, 0)))
+        elif not symmetric:
+            if n_pad:
+                xl = jnp.pad(xl, ((0, n_pad), (0, 0)))
+            if m_pad:
+                yl = jnp.pad(yl, ((0, m_pad), (0, 0)))
+        xl = _ensure_split(xl, 0, comm)
+        yl = xl if symmetric else _ensure_split(yl, 0, comm)
+        if symmetric:
+            result = _ring_dist_sym(xl, metric, comm)
+        else:
+            result = _ring_dist(xl, yl, metric, comm)
+        if n_pad or m_pad:
+            result = result[:n, :m]
     else:
         # one operand replicated (reference distance.py:422-427) — or a layout
         # the ring does not cover: a single sharded expression, XLA schedules it
@@ -133,6 +146,74 @@ def _dist(X: DNDarray, Y: Optional[DNDarray], metric: Callable) -> DNDarray:
     return DNDarray(
         result, tuple(result.shape), types.canonical_heat_type(result.dtype), split, X.device, comm
     )
+
+
+def _sym_schedule(p: int):
+    """Rotation schedule of the symmetric ring: step offsets whose tiles are
+    computed directly; offsets p-i for i in the first half arrive as
+    transposes. ``(paired, self_paired)`` — ``len(paired) (+1 if
+    self_paired)`` rotations instead of the general ring's p-1 (the
+    reference's symmetry halving, distance.py:272-327)."""
+    paired = list(range(1, (p - 1) // 2 + 1))
+    self_paired = p % 2 == 0 and p > 1
+    return paired, self_paired
+
+
+def _ring_dist_sym(xl: jax.Array, metric: Callable, comm) -> jax.Array:
+    """Symmetric systolic ring (Y ≡ X): compute only the upper half of the
+    tile offsets and mirror each tile to its transpose owner — ⌈p/2⌉
+    rotations of the stationary operand instead of p−1, recovering the
+    reference's symmetry optimization (reference distance.py:272-327) with
+    the mirrored tile travelling over the same ICI ring."""
+    from jax.sharding import PartitionSpec as P
+
+    p = comm.size
+    axis = comm.axis_name
+    m_block = xl.shape[0] // p
+    paired, self_paired = _sym_schedule(p)
+
+    def kernel(xs):
+        rank = jax.lax.axis_index(axis)
+
+        def write(out, tile, col_block):
+            col = (col_block % p) * m_block
+            return jax.lax.dynamic_update_slice(
+                out, tile, (jnp.zeros((), col.dtype), col)
+            )
+
+        out = jnp.zeros((xs.shape[0], m_block * p), dtype=xs.dtype)
+        try:
+            out = jax.lax.pcast(out, (axis,), to="varying")
+        except (AttributeError, TypeError):  # pragma: no cover - older jax
+            pass
+        # diagonal tile: local compute, no communication
+        out = write(out, metric(xs, xs), rank)
+        ys_cur = xs
+        # unrolled (p is static): each step needs a distinct mirror shift
+        for i in paired:
+            ys_cur = comm.ppermute(ys_cur, shift=1)  # now holds shard rank+i
+            tile = metric(xs, ys_cur)  # tile (rank, rank+i)
+            out = write(out, tile, rank + i)
+            # mirror: device d receives tile (d-i, d) from d-i, transposes it
+            # into tile (d, d-i) — no recompute of the metric
+            recv = comm.ppermute(tile, shift=-i)
+            out = write(out, recv.T, rank - i)
+        if self_paired:
+            # p even: offset p/2 is its own mirror — every device computes it
+            ys_cur = comm.ppermute(ys_cur, shift=1)
+            out = write(out, metric(xs, ys_cur), rank + p // 2)
+        return out
+
+    fn = jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=comm.mesh,
+            in_specs=P(axis, None),
+            out_specs=P(axis, None),
+            check_vma=False,
+        )
+    )
+    return fn(xl)
 
 
 def _ring_dist(xl: jax.Array, yl: jax.Array, metric: Callable, comm) -> jax.Array:
@@ -158,9 +239,7 @@ def _ring_dist(xl: jax.Array, yl: jax.Array, metric: Callable, comm) -> jax.Arra
             ys_cur, out = carry
             out = fold(i, ys_cur, out)
             # rotate: receive the next shard from the right neighbor
-            ys_next = jax.lax.ppermute(
-                ys_cur, axis, [(j, (j - 1) % p) for j in range(p)]
-            )
+            ys_next = comm.ppermute(ys_cur, shift=1)
             return ys_next, out
 
         out0 = jax.lax.pcast(

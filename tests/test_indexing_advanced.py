@@ -1,0 +1,163 @@
+"""Distribution-preserving advanced indexing (reference dndarray.py:652-908).
+
+The reference spends ~1,000 lines translating global advanced keys to local
+ones; here the gather itself is native (GSPMD) and the contract under test is
+the *split bookkeeping*: boolean-mask and integer-array keys must keep the
+result distributed (VERDICT r1 item 2), with the output re-constrained to the
+computed split — never a silent degrade to replicated.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+import heat_tpu as ht
+
+from harness import TestCase
+
+
+def _np(x):
+    return np.asarray(x.numpy())
+
+
+class TestAdvancedGetitemSplit(TestCase):
+    def setUp(self):
+        self.x_np = np.arange(96, dtype=np.float64).reshape(24, 4)
+        self.x0 = ht.array(self.x_np, split=0)
+        self.x1 = ht.array(self.x_np, split=1)
+
+    def assert_split_and_values(self, result, expected_np, split):
+        self.assertEqual(result.split, split)
+        np.testing.assert_allclose(_np(result), expected_np)
+        if (
+            split is not None
+            and self.comm.size > 1
+            and result.shape[split] % self.comm.size == 0
+        ):
+            # divisible case: the result must actually carry the exact
+            # split sharding (ragged shapes are logical-split only — the
+            # documented _ensure_split contract)
+            spec = result.larray.sharding.spec
+            self.assertTrue(
+                len(spec) > split and spec[split] == self.comm.axis_name,
+                f"sharding spec {spec} does not shard dim {split}",
+            )
+
+    def test_full_boolean_mask(self):
+        mask = self.x0 > 40
+        res = self.x0[mask]
+        self.assert_split_and_values(res, self.x_np[self.x_np > 40], 0)
+
+    def test_row_mask_on_split_axis(self):
+        sel = np.arange(24) % 3 == 0
+        res = self.x0[ht.array(sel)]
+        self.assert_split_and_values(res, self.x_np[sel], 0)
+
+    def test_row_mask_numpy_key(self):
+        sel = np.arange(24) % 2 == 0
+        res = self.x0[sel]
+        self.assert_split_and_values(res, self.x_np[sel], 0)
+
+    def test_integer_array_on_split_axis(self):
+        idx = np.array([1, 5, 2, 7, 3, 0, 9, 11])
+        res = self.x0[idx]
+        self.assert_split_and_values(res, self.x_np[idx], 0)
+
+    def test_integer_array_dndarray_key(self):
+        idx_np = np.array([0, 2, 4, 6, 8, 10, 12, 14])
+        res = self.x0[ht.array(idx_np)]
+        self.assert_split_and_values(res, self.x_np[idx_np], 0)
+
+    def test_integer_array_on_nonsplit_axis(self):
+        res = self.x0[:, np.array([0, 2])]
+        self.assert_split_and_values(res, self.x_np[:, [0, 2]], 0)
+
+    def test_two_dim_integer_key(self):
+        idx2 = np.array([[1, 2], [3, 4]])
+        res = self.x0[idx2]
+        self.assert_split_and_values(res, self.x_np[idx2], 0)
+
+    def test_split1_integer_rows(self):
+        res = self.x1[np.array([0, 3, 5])]
+        self.assert_split_and_values(res, self.x_np[[0, 3, 5]], 1)
+
+    def test_split1_column_key(self):
+        res = self.x1[:, np.array([1, 3])]
+        self.assert_split_and_values(res, self.x_np[:, [1, 3]], 1)
+
+    def test_split1_full_mask(self):
+        res = self.x1[self.x1 > 40]
+        self.assert_split_and_values(res, self.x_np[self.x_np > 40], 0)
+
+    def test_mixed_int_then_array(self):
+        # int consumes axis 0 (the split axis of x0) -> replicated
+        res = self.x0[3, np.array([0, 2])]
+        self.assertIsNone(res.split)
+        np.testing.assert_allclose(_np(res), self.x_np[3, [0, 2]])
+
+    def test_mixed_array_then_int(self):
+        res = self.x0[np.array([3, 5, 7]), 2]
+        self.assert_split_and_values(res, self.x_np[[3, 5, 7], 2], 0)
+
+    def test_two_advanced_keys_replicated(self):
+        res = self.x0[np.array([1, 2]), np.array([0, 1])]
+        self.assertIsNone(res.split)
+        np.testing.assert_allclose(_np(res), self.x_np[[1, 2], [0, 1]])
+
+    def test_newaxis_with_advanced(self):
+        res = self.x0[None, np.array([1, 2, 3, 4])]
+        self.assertEqual(res.split, 1)
+        np.testing.assert_allclose(_np(res), self.x_np[None, [1, 2, 3, 4]])
+
+    def test_ellipsis_with_advanced(self):
+        res = self.x0[..., np.array([0, 1])]
+        self.assert_split_and_values(res, self.x_np[..., [0, 1]], 0)
+
+    def test_3d_mask_partial(self):
+        x_np = np.arange(2 * 8 * 3, dtype=np.float32).reshape(2, 8, 3)
+        x = ht.array(x_np, split=1)
+        # 1-D mask on axis 0 (non-split): split dim 1 stays at out position 1
+        sel = np.array([True, False])
+        res = x[sel]
+        self.assertEqual(res.split, 1)
+        np.testing.assert_allclose(_np(res), x_np[sel])
+
+    def test_3d_integer_on_split_axis(self):
+        x_np = np.arange(2 * 8 * 3, dtype=np.float32).reshape(2, 8, 3)
+        x = ht.array(x_np, split=1)
+        res = x[:, np.array([0, 2, 4, 6])]
+        self.assertEqual(res.split, 1)
+        np.testing.assert_allclose(_np(res), x_np[:, [0, 2, 4, 6]])
+
+
+class TestAdvancedSetitemSplit(TestCase):
+    def test_mask_setitem_keeps_split(self):
+        x_np = np.arange(32, dtype=np.float64).reshape(16, 2)
+        x = ht.array(x_np.copy(), split=0)
+        x[x > 20] = 0.0
+        exp = x_np.copy()
+        exp[exp > 20] = 0.0
+        np.testing.assert_allclose(_np(x), exp)
+        self.assertEqual(x.split, 0)
+        spec = x.larray.sharding.spec
+        self.assertTrue(len(spec) > 0 and spec[0] == self.comm.axis_name)
+
+    def test_integer_array_setitem(self):
+        x_np = np.arange(32, dtype=np.float64).reshape(16, 2)
+        x = ht.array(x_np.copy(), split=0)
+        x[np.array([0, 5, 9])] = -1.0
+        exp = x_np.copy()
+        exp[[0, 5, 9]] = -1.0
+        np.testing.assert_allclose(_np(x), exp)
+        self.assertEqual(x.split, 0)
+
+    def test_setitem_value_dndarray(self):
+        x_np = np.zeros((16, 3))
+        x = ht.array(x_np.copy(), split=0)
+        v = ht.array(np.ones((4, 3)), split=0)
+        x[np.array([1, 3, 5, 7])] = v
+        exp = x_np.copy()
+        exp[[1, 3, 5, 7]] = 1.0
+        np.testing.assert_allclose(_np(x), exp)

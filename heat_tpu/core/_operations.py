@@ -59,14 +59,49 @@ def __binary_op(
     ref = t1 if isinstance(t1, DNDarray) else t2
     comm, device = ref.comm, ref.device
 
-    a, s1 = _as_operand(t1, comm, device)
-    b, s2 = _as_operand(t2, comm, device)
-
     # dtype promotion (reference _operations.py:87): operands are cast to the
     # promoted type BEFORE the op so op-induced promotion (e.g. true_divide of
     # integers -> float) is preserved rather than clobbered afterwards.
     out_dtype = types.result_type(t1, t2)
     jt = out_dtype.jax_type()
+
+    # pad-aware fast path: identical-layout ragged operands (or ragged⊗scalar)
+    # compute directly on the physical payloads — the padding suffix computes
+    # garbage that stays in the padding, no reshard/gather happens, and the
+    # result keeps the block layout (SURVEY.md §7 pad+mask).
+    scalar_types = (int, float, bool, complex, np.number, np.bool_)
+    if where is None:
+        phys = None
+        if (
+            isinstance(t1, DNDarray)
+            and isinstance(t2, DNDarray)
+            and t1.split == t2.split
+            and t1.shape == t2.shape
+            and t1.padded
+        ):
+            phys = (t1.parray.astype(jt), t2.parray.astype(jt))
+            out_shape, out_split = t1.shape, t1.split
+        elif isinstance(t1, DNDarray) and t1.padded and isinstance(t2, scalar_types):
+            phys = (t1.parray.astype(jt), jnp.asarray(t2, dtype=jt))
+            out_shape, out_split = t1.shape, t1.split
+        elif isinstance(t2, DNDarray) and t2.padded and isinstance(t1, scalar_types):
+            phys = (jnp.asarray(t1, dtype=jt), t2.parray.astype(jt))
+            out_shape, out_split = t2.shape, t2.split
+        if phys is not None:
+            result = operation(phys[0], phys[1], **fn_kwargs)
+            wrapped = DNDarray(
+                result, out_shape, types.canonical_heat_type(result.dtype), out_split, device, comm
+            )
+            if out is not None:
+                sanitation.sanitize_out(out, out_shape, out_split, device)
+                out._replace(
+                    result.astype(out.dtype.jax_type()), out_split, gshape=out_shape
+                )
+                return out
+            return wrapped
+
+    a, s1 = _as_operand(t1, comm, device)
+    b, s2 = _as_operand(t2, comm, device)
     a = jnp.asarray(a, dtype=jt)
     b = jnp.asarray(b, dtype=jt)
 
@@ -99,7 +134,9 @@ def __binary_op(
     )
     if out is not None:
         sanitation.sanitize_out(out, out_shape, out_split, device)
-        out._replace(result.astype(out.dtype.jax_type()), out_split)
+        out._replace(
+            wrapped.parray.astype(out.dtype.jax_type()), out_split, gshape=wrapped.shape
+        )
         return out
     return wrapped
 
@@ -115,23 +152,46 @@ def __local_op(
     _operations.py:305-376). Promotes exact types to floating unless
     ``no_cast``."""
     sanitation.sanitize_in(x)
-    arr = x.larray
+    padded = x.padded
+    # pad-aware fast path: elementwise on the physical payload; the padding
+    # suffix computes garbage that stays in the padding (SURVEY.md §7)
+    arr = x.parray if padded else x.larray
     if not no_cast and types.heat_type_is_exact(x.dtype):
         target = types.promote_types(x.dtype, types.float32)
         arr = arr.astype(target.jax_type())
     result = operation(arr, **kwargs)
-    result = _ensure_split(result, x.split if result.ndim == x.ndim else None, x.comm)
-    wrapped = DNDarray(
-        result,
-        tuple(result.shape),
-        types.canonical_heat_type(result.dtype),
-        x.split if result.ndim == x.ndim else None,
-        x.device,
-        x.comm,
-    )
+    if padded and result.shape != arr.shape:
+        # shape-changing op: redo from the logical view (rare; elementwise
+        # ops — the whole local_op clientele — never take this branch)
+        arr = x.larray
+        if not no_cast and types.heat_type_is_exact(x.dtype):
+            arr = arr.astype(types.promote_types(x.dtype, types.float32).jax_type())
+        result = operation(arr, **kwargs)
+        padded = False
+    if padded:
+        wrapped = DNDarray(
+            result,
+            x.shape,
+            types.canonical_heat_type(result.dtype),
+            x.split,
+            x.device,
+            x.comm,
+        )
+    else:
+        result = _ensure_split(result, x.split if result.ndim == x.ndim else None, x.comm)
+        wrapped = DNDarray(
+            result,
+            tuple(result.shape),
+            types.canonical_heat_type(result.dtype),
+            x.split if result.ndim == x.ndim else None,
+            x.device,
+            x.comm,
+        )
     if out is not None:
         sanitation.sanitize_out(out, wrapped.shape, wrapped.split, wrapped.device)
-        out._replace(result.astype(out.dtype.jax_type()), wrapped.split)
+        out._replace(
+            wrapped.parray.astype(out.dtype.jax_type()), wrapped.split, gshape=wrapped.shape
+        )
         return out
     return wrapped
 
@@ -154,38 +214,62 @@ def __reduce_op(
     """
     sanitation.sanitize_in(x)
     axis = sanitize_axis(x.shape, axis)
-    result = partial_op(
-        x.larray, axis=axis, keepdims=keepdims, **kwargs
-    )
-    if dtype is not None:
-        result = result.astype(types.canonical_heat_type(dtype).jax_type())
 
     # split bookkeeping (reference _operations.py:470-490)
     split = x.split
-    if split is None or axis is None:
+    axes = None if axis is None else ((axis,) if isinstance(axis, int) else tuple(axis))
+    if split is None or axes is None:
         out_split = None
+    elif split in axes:
+        out_split = None
+    elif keepdims:
+        out_split = split
     else:
-        axes = (axis,) if isinstance(axis, int) else tuple(axis)
-        if split in axes:
-            out_split = None
-        elif keepdims:
-            out_split = split
-        else:
-            out_split = split - sum(1 for a in axes if a < split)
+        out_split = split - sum(1 for a in axes if a < split)
+
+    # pad-aware fast path: reducing only non-split axes of a ragged array —
+    # the padding suffix reduces into the (shifted) padding suffix of the
+    # result, so the physical payload can be reduced directly with no
+    # reshard/gather. Reductions ACROSS the split axis take the logical view
+    # (the mask step of pad+mask: padding must not enter the reduction).
+    padded_fast = x.padded and axes is not None and split not in axes
+    src = x.parray if padded_fast else x.larray
+    result = partial_op(src, axis=axis, keepdims=keepdims, **kwargs)
+    if dtype is not None:
+        result = result.astype(types.canonical_heat_type(dtype).jax_type())
+
     if out_split is not None and (result.ndim == 0 or out_split >= result.ndim):
         out_split = None
-    result = _ensure_split(result, out_split, x.comm)
-    wrapped = DNDarray(
-        result,
-        tuple(result.shape),
-        types.canonical_heat_type(result.dtype),
-        out_split,
-        x.device,
-        x.comm,
-    )
+    if padded_fast:
+        gshape = list(x.shape)
+        for a in sorted(axes, reverse=True):
+            if keepdims:
+                gshape[a] = 1
+            else:
+                del gshape[a]
+        wrapped = DNDarray(
+            result,
+            tuple(gshape),
+            types.canonical_heat_type(result.dtype),
+            out_split,
+            x.device,
+            x.comm,
+        )
+    else:
+        result = _ensure_split(result, out_split, x.comm)
+        wrapped = DNDarray(
+            result,
+            tuple(result.shape),
+            types.canonical_heat_type(result.dtype),
+            out_split,
+            x.device,
+            x.comm,
+        )
     if out is not None:
         sanitation.sanitize_out(out, wrapped.shape, wrapped.split, wrapped.device)
-        out._replace(result.astype(out.dtype.jax_type()), wrapped.split)
+        out._replace(
+            wrapped.parray.astype(out.dtype.jax_type()), wrapped.split, gshape=wrapped.shape
+        )
         return out
     return wrapped
 
@@ -203,15 +287,27 @@ def __cum_op(
     axis = sanitize_axis(x.shape, axis)
     if not isinstance(axis, int):
         raise TypeError("axis must be a single integer for cumulative operations")
-    result = operation(x.larray, axis=axis)
+    # pad-aware fast path: the padding is a *suffix* of the global split dim,
+    # so a cumulative op along ANY axis leaves the data region untouched —
+    # along the split axis the garbage only accumulates past position n,
+    # along other axes padding rows stay padding rows.
+    padded = x.padded
+    result = operation(x.parray if padded else x.larray, axis=axis)
     if dtype is not None:
         result = result.astype(types.canonical_heat_type(dtype).jax_type())
-    result = _ensure_split(result, x.split, x.comm)
-    wrapped = DNDarray(
-        result, tuple(result.shape), types.canonical_heat_type(result.dtype), x.split, x.device, x.comm
-    )
+    if padded:
+        wrapped = DNDarray(
+            result, x.shape, types.canonical_heat_type(result.dtype), x.split, x.device, x.comm
+        )
+    else:
+        result = _ensure_split(result, x.split, x.comm)
+        wrapped = DNDarray(
+            result, tuple(result.shape), types.canonical_heat_type(result.dtype), x.split, x.device, x.comm
+        )
     if out is not None:
         sanitation.sanitize_out(out, wrapped.shape, wrapped.split, wrapped.device)
-        out._replace(result.astype(out.dtype.jax_type()), wrapped.split)
+        out._replace(
+            wrapped.parray.astype(out.dtype.jax_type()), wrapped.split, gshape=wrapped.shape
+        )
         return out
     return wrapped

@@ -12,13 +12,18 @@ axis — stays identical.
 
 Key semantic notes
 ------------------
-* ``larray`` returns the underlying **global** ``jax.Array`` (the natural JAX
+* ``larray`` returns the **global logical** ``jax.Array`` (the natural JAX
   handle for local compute under SPMD). Per-device shards are exposed via
   ``lshards``/``lshape``/``lshape_map``.
 * Arrays are always *balanced* in GSPMD's ceil-division layout; the
   reference's ragged ``lshape_map``/``balanced=False`` machinery
   (dndarray.py:57-60) intentionally does not exist (SURVEY.md §7 design
-  stance).
+  stance). Global sizes not divisible by the mesh size are handled by
+  **pad+mask**: the stored *physical* payload (``parray``) is zero-padded
+  along the split axis to ``p * ceil(n/p)`` — a suffix of the global dim —
+  so every device holds exactly one block-sized shard; ``gshape`` stays
+  logical and ``larray`` slices the padding off. The reference instead
+  carries ragged local chunks per rank (dndarray.py:57-60).
 * "In-place" methods (``resplit_``, ``balance_``, ``__setitem__``) mutate the
   wrapper's handle to a new immutable ``jax.Array`` — aliasing differs from
   the reference (documented deviation).
@@ -26,6 +31,7 @@ Key semantic notes
 
 from __future__ import annotations
 
+import functools
 import warnings
 from typing import Iterable, List, Optional, Sequence, Tuple, Union
 
@@ -90,13 +96,30 @@ class DNDarray:
         comm: Communication,
         balanced: bool = True,
     ):
-        self.__array = array
         self.__gshape = tuple(int(s) for s in gshape)
         self.__dtype = dtype
         self.__split = split
         self.__device = device
         self.__comm = comm
         self.__balanced = True
+        # pad+mask for ragged splits: if the (logical) payload's split dim is
+        # not divisible by the mesh size, physically pad it to p*ceil(n/p) and
+        # shard — every device then holds one block-sized shard instead of a
+        # full replica (reference carries ragged chunks per rank,
+        # dndarray.py:57-60; SURVEY.md §7 prescribes pad+mask on TPU).
+        # Payloads arriving already at the padded physical shape (internal
+        # reconstructions, e.g. astype) are stored as-is.
+        if (
+            split is not None
+            and isinstance(array, jax.Array)
+            and array.ndim > 0
+            and split < array.ndim
+            and tuple(array.shape) == self.__gshape
+            and comm is not None
+            and self.__gshape[split] % comm.size != 0
+        ):
+            array = _pad_and_place(array, split, comm)
+        self.__array = array
 
     # ------------------------------------------------------------------
     # basic properties
@@ -151,31 +174,92 @@ class DNDarray:
         return self.lnumel * np.dtype(self.__dtype.jax_type()).itemsize
 
     @property
-    def larray(self) -> jax.Array:
-        """The underlying global ``jax.Array`` (see module docstring)."""
+    def padded(self) -> bool:
+        """True when the physical payload carries suffix padding along the
+        split axis (ragged global size, see module docstring)."""
+        s = self.__split
+        return s is not None and s < self.__array.ndim and (
+            int(self.__array.shape[s]) != self.__gshape[s]
+        )
+
+    @property
+    def parray(self) -> jax.Array:
+        """The *physical* payload: the stored ``jax.Array``, zero-padded along
+        the split axis to ``p * ceil(n/p)`` when the global size is ragged.
+        Pad-aware fast paths (elementwise engines, shard_map kernels) may
+        compute on it directly; the padding region's content is unspecified."""
         return self.__array
+
+    @property
+    def larray(self) -> jax.Array:
+        """The **logical** global ``jax.Array`` (see module docstring): the
+        physical payload with any split-axis suffix padding sliced off."""
+        if not self.padded:
+            return self.__array
+        idx = [slice(None)] * self.__array.ndim
+        idx[self.__split] = slice(0, self.__gshape[self.__split])
+        return self.__array[tuple(idx)]
 
     @larray.setter
     def larray(self, array: jax.Array):
-        """Replace the payload (reference dndarray.py:229-247); shape/dtype
-        metadata is re-derived from the new array."""
+        """Replace the payload with a new **logical** array (reference
+        dndarray.py:229-247); shape/dtype metadata is re-derived and ragged
+        splits are re-padded."""
         if not isinstance(array, jax.Array):
             raise TypeError(f"larray must be a jax.Array, got {type(array)}")
-        self.__array = array
         self.__gshape = tuple(int(s) for s in array.shape)
         self.__dtype = types.canonical_heat_type(array.dtype)
+        split = self.__split
+        if split is not None and (array.ndim == 0 or split >= array.ndim):
+            self.__split = split = None
+        if split is not None and self.__gshape[split] % self.__comm.size != 0:
+            array = _pad_and_place(array, split, self.__comm)
+        self.__array = array
 
-    def _replace(self, array: jax.Array, split: Optional[int]) -> "DNDarray":
+    def _replace(
+        self, array: jax.Array, split: Optional[int], gshape: Optional[Tuple[int, ...]] = None
+    ) -> "DNDarray":
         """Internal: swap payload AND split metadata consistently (used by the
-        op engines' ``out=`` paths)."""
-        self.larray = array
+        op engines' ``out=`` paths). With ``gshape`` given, ``array`` is taken
+        as the physical (possibly padded) payload for that logical shape."""
         self.__split = split
+        if gshape is not None:
+            gshape = tuple(int(s) for s in gshape)
+            expected = list(gshape)
+            if split is not None and split < len(expected):
+                p = self.__comm.size
+                n = expected[split]
+                expected[split] = (-(-n // p) if n else 0) * p
+            if tuple(array.shape) not in (gshape, tuple(expected)):
+                raise ValueError(
+                    f"physical payload shape {tuple(array.shape)} matches neither the "
+                    f"logical shape {gshape} nor its padded form {tuple(expected)}"
+                )
+            self.__array = array
+            self.__gshape = gshape
+            self.__dtype = types.canonical_heat_type(array.dtype)
+        else:
+            self.larray = array
         return self
 
     @property
     def lshards(self) -> List[np.ndarray]:
-        """Per-device local shards (host copies), in device order."""
-        return [np.asarray(s.data) for s in self.__array.addressable_shards]
+        """Per-device **logical** local shards (host copies), in device order:
+        each physical shard with its padding rows sliced off (tail devices of
+        a ragged split may hold empty logical shards)."""
+        if not self.padded:
+            return [np.asarray(s.data) for s in self.__array.addressable_shards]
+        split = self.__split
+        counts, _ = self.__comm.counts_displs_shape(self.__gshape, split)
+        block = int(self.__array.shape[split]) // self.__comm.size
+        out = []
+        for s in self.__array.addressable_shards:
+            start = s.index[split].start or 0
+            rank = start // block if block else 0
+            idx = [slice(None)] * self.__array.ndim
+            idx[split] = slice(0, counts[rank])
+            out.append(np.asarray(s.data[tuple(idx)]))
+        return out
 
     @property
     def lshape(self) -> Tuple[int, ...]:
@@ -261,8 +345,12 @@ class DNDarray:
         axis = sanitize_axis(self.__gshape, axis)
         if axis == self.__split:
             return self
-        self.__array = _ensure_split(self.__array, axis, self.__comm)
+        logical = self.larray
         self.__split = axis
+        if axis is not None and self.__gshape[axis] % self.__comm.size != 0:
+            self.__array = _pad_and_place(logical, axis, self.__comm)
+        else:
+            self.__array = _ensure_split(logical, axis, self.__comm)
         return self
 
     def redistribute_(self, lshape_map=None, target_map=None) -> "DNDarray":
@@ -292,7 +380,7 @@ class DNDarray:
     @property
     def array_with_halos(self) -> jax.Array:
         """Global array view (halos are implicit in the global view)."""
-        return self.__array
+        return self.larray
 
     @property
     def halo_prev(self) -> Optional[jax.Array]:
@@ -306,7 +394,7 @@ class DNDarray:
         stop = slices[self.__split].stop
         idx = [slice(None)] * len(self.__gshape)
         idx[self.__split] = slice(max(stop - hs, 0), stop)
-        return self.__array[tuple(idx)]
+        return self.larray[tuple(idx)]
 
     @property
     def halo_next(self) -> Optional[jax.Array]:
@@ -319,7 +407,7 @@ class DNDarray:
         start = slices[self.__split].start
         idx = [slice(None)] * len(self.__gshape)
         idx[self.__split] = slice(start, start + hs)
-        return self.__array[tuple(idx)]
+        return self.larray[tuple(idx)]
 
     def create_lshape_map(self, force_check: bool = False):
         """Method form of ``lshape_map`` (reference dndarray.py:569-600)."""
@@ -341,8 +429,9 @@ class DNDarray:
         return self
 
     def numpy(self) -> np.ndarray:
-        """Gather the global array to host numpy (reference dndarray.py:991-1003)."""
-        return np.asarray(jax.device_get(self.__array))
+        """Gather the global (logical) array to host numpy (reference
+        dndarray.py:991-1003); padding never leaves the device."""
+        return np.asarray(jax.device_get(self.larray))
 
     def __array__(self, dtype=None) -> np.ndarray:
         out = self.numpy()
@@ -352,7 +441,7 @@ class DNDarray:
         """The single scalar value (reference dndarray.py:965)."""
         if self.size != 1:
             raise ValueError("only one-element DNDarrays can be converted to Python scalars")
-        return self.__array.item()
+        return self.larray.item()
 
     def tolist(self, keepsplit: bool = False) -> list:
         return self.numpy().tolist()
@@ -489,7 +578,7 @@ class DNDarray:
 
     def __getitem__(self, key) -> "DNDarray":
         jkey = DNDarray._unwrap_key(key)
-        result = self.__array[jkey]
+        result = self.larray[jkey]
         split = self._result_split(key) if result.ndim > 0 else None
         if split is not None and split >= result.ndim:
             split = None
@@ -510,8 +599,11 @@ class DNDarray:
         # numpy setitem semantics: the value is cast to the destination dtype
         if hasattr(value, "dtype") and value.dtype != self.__array.dtype:
             value = jnp.asarray(value).astype(self.__array.dtype)
-        new = self.__array.at[jkey].set(value)
-        self.__array = _ensure_split(new, self.__split, self.__comm)
+        new = self.larray.at[jkey].set(value)
+        if self.padded:
+            self.__array = _pad_and_place(new, self.__split, self.__comm)
+        else:
+            self.__array = _ensure_split(new, self.__split, self.__comm)
 
     def fill_diagonal(self, value) -> "DNDarray":
         """Fill the main diagonal in place (reference dndarray.py:608-650)."""
@@ -519,8 +611,11 @@ class DNDarray:
             raise ValueError("Only 2D tensors supported")
         n = min(self.__gshape)
         idx = jnp.arange(n)
-        new = self.__array.at[idx, idx].set(value)
-        self.__array = _ensure_split(new, self.__split, self.__comm)
+        new = self.larray.at[idx, idx].set(value)
+        if self.padded:
+            self.__array = _pad_and_place(new, self.__split, self.__comm)
+        else:
+            self.__array = _ensure_split(new, self.__split, self.__comm)
         return self
 
     # ------------------------------------------------------------------
@@ -712,24 +807,48 @@ def _key_ndim(k) -> int:
     return k.ndim
 
 
+@functools.lru_cache(maxsize=None)
+def _pad_program(widths: Tuple[Tuple[int, int], ...], target) -> callable:
+    """Cached compiled pad-with-out-sharding program (keyed on pad widths and
+    the target NamedSharding so repeated ragged wraps never retrace)."""
+    return jax.jit(lambda a: jnp.pad(jnp.asarray(a), widths), out_shardings=target)
+
+
+def _pad_and_place(array: jax.Array, split: int, comm: MeshCommunication) -> jax.Array:
+    """Physically realize a ragged split: zero-pad the split dim of the
+    (logical) ``array`` to ``p * ceil(n/p)`` — a *suffix* of the global dim —
+    and place the result under the split NamedSharding, so every device holds
+    exactly one block-sized shard. One compiled pad-with-out-sharding program;
+    no device ever materializes the full array at rest. The reference instead
+    carries ragged per-rank chunks (reference dndarray.py:57-60); JAX rejects
+    uneven NamedShardings outright, so pad+mask is the TPU rendering
+    (SURVEY.md §7)."""
+    n = int(array.shape[split])
+    p = comm.size
+    block = -(-n // p) if n else 0
+    pad = block * p - n
+    target = comm.sharding(array.ndim, split)
+    if pad == 0:  # pragma: no cover - callers guard, kept for safety
+        return jax.device_put(array, target)
+    widths = [(0, 0)] * array.ndim
+    widths[split] = (0, pad)
+    return _pad_program(tuple(widths), target)(array)
+
+
 def _ensure_split(array: jax.Array, split: Optional[int], comm: MeshCommunication) -> jax.Array:
     """Place ``array`` under the sharding implied by ``split`` if it is not
     already there. Eager resharding is one ``device_put`` (XLA collective).
 
-    Dimensions not divisible by the mesh size cannot carry a NamedSharding at
-    all in JAX (device_put/out_shardings/make_array_from_callback all reject
-    them), so a ragged ``split`` is *logical only*: the array keeps its
-    current physical placement (typically replicated) and ``split`` records
-    the intended distribution, which the next divisible-shape op restores.
-    This is the SURVEY.md §7 "balanced-only fast path" stance — the reference
-    itself prefers balanced arrays and carries ragged ones as metadata
-    (reference dndarray.py:57-60). The behavior is pinned by
-    tests/test_indexing_advanced.py and tests/test_edge_behaviors.py.
+    Dimensions not divisible by the mesh size cannot carry a NamedSharding in
+    JAX (device_put/out_shardings/make_array_from_callback all reject them),
+    so for a ragged ``split`` the array is returned untouched: the
+    ``DNDarray`` constructor (every wrap site funnels through it) realizes
+    the distribution physically via :func:`_pad_and_place`.
     """
     if array.ndim == 0:
         split = None
     if split is not None and array.shape[split] % comm.size != 0:
-        return array  # ragged: logical split only, no representable layout
+        return array  # ragged: the DNDarray constructor pads + places
     target = comm.sharding(array.ndim, split)
     current = getattr(array, "sharding", None)
     if current is not None:

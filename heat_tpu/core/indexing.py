@@ -47,4 +47,30 @@ def where(cond: DNDarray, x=None, y=None) -> DNDarray:
             cond.device,
             cond.comm,
         )
-    return _binary_op(lambda a, b: jnp.where(cond.larray.astype(bool), a, b), x, y)
+    x_ref = x if isinstance(x, DNDarray) else y
+
+    def op(a, b):
+        # the engine's pad-aware fast path hands us PHYSICAL (padded) payloads;
+        # align cond to the same layout (garbage selected in the padding
+        # region stays in the padding region)
+        c = cond.larray
+        a_sh = tuple(getattr(a, "shape", ()))
+        if (
+            isinstance(x_ref, DNDarray)
+            and x_ref.padded
+            and a_sh == tuple(x_ref.parray.shape)
+            and cond.ndim == x_ref.ndim
+            and cond.shape[x_ref.split] == x_ref.shape[x_ref.split]
+        ):
+            if cond.split == x_ref.split:
+                c = cond.parray
+            else:
+                widths = [(0, 0)] * cond.ndim
+                widths[x_ref.split] = (
+                    0,
+                    int(x_ref.parray.shape[x_ref.split]) - cond.shape[x_ref.split],
+                )
+                c = jnp.pad(c, widths)
+        return jnp.where(c.astype(bool), a, b)
+
+    return _binary_op(op, x, y)

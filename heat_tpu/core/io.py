@@ -3,17 +3,30 @@
 The reference's parallel HDF5 path has every MPI rank slice its own chunk
 (io.py:119-147) and write through the mpio driver or a token-ring of
 serialized writes (:198-226); CSV reads are split by byte ranges (:713-925).
-Under a single controller the device shards come from one host-side read that
-is then scattered by ``device_put`` — on a multi-host deployment each host
-reads its addressable slice (the same per-chunk slicing, via
-``jax.make_array_from_callback``). netCDF support is gated on the library's
-presence (absent in this environment).
+The TPU rendering keeps the per-chunk protocol:
+
+* **HDF5 load**: each device's block is read *directly from the file* as its
+  own ``h5py`` slice (true partial I/O — HDF5 reads only the requested
+  hyperslab) and placed on its device; the global array is stitched with
+  ``jax.make_array_from_single_device_arrays``. No host allocation ever
+  equals the global array.
+* **HDF5 save**: streamed per physical shard — each block is written as its
+  own hyperslab, never gathering the global array to the host.
+* **CSV load (split=0)**: the file is memory-mapped, newline offsets are
+  scanned in bounded chunks, and each device's row range is parsed from its
+  own byte range — the reference's byte-range splitting (io.py:713-925).
+* **netCDF**: netCDF4 files *are* HDF5 files; load/save are implemented over
+  ``h5py`` with netCDF dimension-scale conventions (reference io.py:246-660
+  uses the netCDF4 library; this environment ships h5py only). Classic
+  NETCDF3 (CDF magic) is detected and rejected with a clear error.
 """
 
 from __future__ import annotations
 
 import csv as csv_module
+import mmap
 import os
+from io import BytesIO
 from typing import List, Optional, Tuple, Union
 
 import numpy as np
@@ -31,13 +44,6 @@ try:
 except ImportError:  # pragma: no cover
     __HAS_HDF5 = False
     __HDF5_EXTENSIONS = frozenset()
-
-try:  # pragma: no cover - netCDF4 absent in this environment
-    import netCDF4 as nc
-
-    __HAS_NETCDF = True
-except ImportError:
-    __HAS_NETCDF = False
 
 __CSV_EXTENSION = frozenset([".csv"])
 __NETCDF_EXTENSIONS = frozenset([".nc", ".nc4", ".netcdf"])
@@ -62,8 +68,9 @@ def supports_hdf5() -> bool:
 
 
 def supports_netcdf() -> bool:
-    """True if netCDF I/O is available (reference io.py:49-57)."""
-    return __HAS_NETCDF
+    """True if netCDF I/O is available (reference io.py:49-57). netCDF4 files
+    are HDF5 containers, so support rides on h5py."""
+    return __HAS_HDF5
 
 
 def load(path: str, *args, **kwargs) -> DNDarray:
@@ -103,6 +110,51 @@ def save(data: DNDarray, path: str, *args, **kwargs) -> None:
 
 
 # ----------------------------------------------------------------------------
+# sharded ingest core
+# ----------------------------------------------------------------------------
+def _sharded_ingest(read_block, gshape, dtype, split, device, comm) -> DNDarray:
+    """Assemble a split DNDarray by reading each device's block separately.
+
+    ``read_block(slices) -> np.ndarray`` reads one hyperslab from the source
+    (HDF5 dataset, CSV byte range, ...). Each block is padded to the uniform
+    block size (pad+mask contract) and placed on its device; the global array
+    is stitched with ``jax.make_array_from_single_device_arrays`` — the TPU
+    rendering of the reference's every-rank-slices-its-own-chunk protocol
+    (reference io.py:119-147). No host allocation equals the global array.
+    """
+    import jax
+
+    jdt = np.dtype(types.canonical_heat_type(dtype).jax_type())
+    p = comm.size
+    n = gshape[split]
+    block = -(-n // p) if n else 0
+    pshape = list(gshape)
+    pshape[split] = block * p
+    counts, displs = comm.counts_displs_shape(gshape, split)
+    sharding = comm.sharding(len(gshape), split)
+    try:
+        proc = jax.process_index()
+    except Exception:  # pragma: no cover
+        proc = 0
+    arrays = []
+    for r, d in enumerate(comm.devices):
+        if d.process_index != proc:
+            continue  # multi-host: each host reads only its addressable blocks
+        sl = [slice(None)] * len(gshape)
+        sl[split] = slice(displs[r], displs[r] + counts[r])
+        local = np.asarray(read_block(tuple(sl)), dtype=jdt)
+        if counts[r] < block:
+            widths = [(0, 0)] * len(gshape)
+            widths[split] = (0, block - counts[r])
+            local = np.pad(local, widths)
+        arrays.append(jax.device_put(local, d))
+    arr = jax.make_array_from_single_device_arrays(tuple(pshape), sharding, arrays)
+    return DNDarray(
+        arr, tuple(gshape), types.canonical_heat_type(dtype), split, device, comm
+    )
+
+
+# ----------------------------------------------------------------------------
 # HDF5 (reference io.py:58-245)
 # ----------------------------------------------------------------------------
 def load_hdf5(
@@ -114,7 +166,10 @@ def load_hdf5(
     device=None,
     comm=None,
 ) -> DNDarray:
-    """Load an HDF5 dataset (reference io.py:58-147)."""
+    """Load an HDF5 dataset (reference io.py:58-147: every rank reads its own
+    chunk). With ``split`` given, each device's block is read as its own h5py
+    hyperslab — HDF5 performs true partial I/O, so no host-side allocation
+    ever holds the full dataset."""
     if not isinstance(path, str):
         raise TypeError(f"path must be str, but was {type(path)}")
     if not isinstance(dataset, str):
@@ -123,18 +178,28 @@ def load_hdf5(
         raise TypeError(f"load_fraction must be float, but was {type(load_fraction)}")
     if load_fraction <= 0.0 or load_fraction > 1.0:
         raise ValueError(f"load_fraction must be in (0, 1], but was {load_fraction}")
+    comm = sanitize_comm(comm)
+    device = devices_module.sanitize_device(device)
     with h5py.File(path, "r") as handle:
         data = handle[dataset]
+        gshape = list(data.shape)
         if load_fraction < 1.0 and split == 0:
-            n = int(data.shape[0] * load_fraction)
-            arr = np.asarray(data[:n])
-        else:
-            arr = np.asarray(data)
-    return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
+            gshape[0] = int(gshape[0] * load_fraction)
+        gshape = tuple(gshape)
+        if split is None or len(gshape) == 0:
+            sl = tuple(slice(0, s) for s in gshape)
+            arr = np.asarray(data[sl] if gshape else data[()])
+            return factories.array(arr, dtype=dtype, split=None, device=device, comm=comm)
+        split = split % len(gshape)
+        return _sharded_ingest(
+            lambda sl: data[sl], gshape, dtype, split, device, comm
+        )
 
 
 def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs) -> None:
-    """Save to an HDF5 dataset (reference io.py:148-245)."""
+    """Save to an HDF5 dataset (reference io.py:148-245: parallel mpio write /
+    token-ring). Split arrays are streamed per physical shard — each block is
+    written as its own hyperslab, never gathering the global array."""
     if not isinstance(data, DNDarray):
         raise TypeError(f"data must be heat tensor, but was {type(data)}")
     if not isinstance(path, str):
@@ -144,41 +209,127 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
     if mode not in ("w", "a", "r+"):
         raise ValueError(f"mode was {mode}, not in possible modes ('w', 'a', 'r+')")
     with h5py.File(path, mode) as handle:
-        handle.create_dataset(dataset, data=data.numpy(), **kwargs)
+        _write_h5_dataset(handle, dataset, data, **kwargs)
+
+
+def _write_h5_dataset(handle, dataset: str, data: DNDarray, **kwargs):
+    """Create ``dataset`` and stream ``data`` into it shard by shard."""
+    jdt = np.dtype(data.dtype.jax_type())
+    dset = handle.create_dataset(dataset, shape=data.shape, dtype=jdt, **kwargs)
+    split = data.split
+    if split is None or data.ndim == 0:
+        dset[...] = data.numpy()
+        return dset
+    counts, displs = data.comm.counts_displs_shape(data.shape, split)
+    phys = data.parray
+    block = int(phys.shape[split]) // data.comm.size
+    for s in phys.addressable_shards:
+        start = s.index[split].start or 0
+        r = start // block if block else 0
+        c = counts[r]
+        if c == 0:
+            continue
+        idx = [slice(None)] * data.ndim
+        idx[split] = slice(0, c)
+        tgt = list(s.index)
+        tgt[split] = slice(displs[r], displs[r] + c)
+        dset[tuple(tgt)] = np.asarray(s.data[tuple(idx)])
+    return dset
 
 
 # ----------------------------------------------------------------------------
-# netCDF (reference io.py:246-661) — gated
+# netCDF over h5py (reference io.py:246-661)
+#
+# netCDF4 files are HDF5 containers; the reference drives them through the
+# netCDF4 library (absent in this environment). Variables are plain HDF5
+# datasets carrying dimension scales, which h5py manipulates natively — so
+# load/save speak the netCDF4 enhanced-model conventions directly and reuse
+# the sharded HDF5 machinery above. Classic NETCDF3 files (magic b"CDF") are
+# a different on-disk format and rejected explicitly.
 # ----------------------------------------------------------------------------
+def _reject_netcdf3(path: str) -> None:
+    with open(path, "rb") as f:
+        magic = f.read(3)
+    if magic == b"CDF":
+        raise RuntimeError(
+            "classic NETCDF3 format is not supported (requires the netCDF4 "
+            "library); re-save the file as NETCDF4 (HDF5-based)"
+        )
+
+
 def load_netcdf(
     path: str, variable: str, dtype=types.float32, split: Optional[int] = None, device=None, comm=None
 ) -> DNDarray:
-    """Load a netCDF variable (reference io.py:246-414)."""
-    if not supports_netcdf():
-        raise RuntimeError("netCDF4 is not available in this environment")
-    with nc.Dataset(path, "r") as handle:  # pragma: no cover
-        arr = np.asarray(handle[variable][:])
-    return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)  # pragma: no cover
+    """Load a netCDF4 variable (reference io.py:246-414: every rank slices
+    its own chunk). Same per-device hyperslab protocol as :func:`load_hdf5`."""
+    if not isinstance(path, str):
+        raise TypeError(f"path must be str, but was {type(path)}")
+    if not isinstance(variable, str):
+        raise TypeError(f"variable must be str, but was {type(variable)}")
+    _reject_netcdf3(path)
+    return load_hdf5(path, variable, dtype=dtype, split=split, device=device, comm=comm)
 
 
-def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwargs) -> None:
-    """Save to a netCDF variable (reference io.py:415-661)."""
-    if not supports_netcdf():
-        raise RuntimeError("netCDF4 is not available in this environment")
-    with nc.Dataset(path, mode) as handle:  # pragma: no cover
-        arr = data.numpy()
-        dims = []
-        for i, s in enumerate(arr.shape):
-            name = f"dim_{variable}_{i}"
-            handle.createDimension(name, s)
-            dims.append(name)
-        var = handle.createVariable(variable, arr.dtype, tuple(dims))
-        var[:] = arr
+def save_netcdf(
+    data: DNDarray, path: str, variable: str, mode: str = "w", dimension_names=None, **kwargs
+) -> None:
+    """Save to a netCDF4 (HDF5-based) variable (reference io.py:415-661).
+
+    Writes the variable with netCDF dimension-scale conventions: one
+    dimension-scale dataset per axis (named ``dimension_names[i]`` or
+    ``<variable>_dim_<i>``) attached via the HDF5 dimension-scales API, so the
+    file round-trips through the netCDF4 library. Data is streamed per shard
+    like :func:`save_hdf5`."""
+    if not isinstance(data, DNDarray):
+        raise TypeError(f"data must be heat tensor, but was {type(data)}")
+    if not isinstance(path, str):
+        raise TypeError(f"path must be str, but was {type(path)}")
+    if not isinstance(variable, str):
+        raise TypeError(f"variable must be str, but was {type(variable)}")
+    if mode not in ("w", "a", "r+"):
+        raise ValueError(f"mode was {mode}, not in possible modes ('w', 'a', 'r+')")
+    if dimension_names is None:
+        dimension_names = [f"{variable}_dim_{i}" for i in range(data.ndim)]
+    elif len(dimension_names) != data.ndim:
+        raise ValueError(
+            f"{len(dimension_names)} names given for {data.ndim} dimensions"
+        )
+    with h5py.File(path, mode) as handle:
+        dset = _write_h5_dataset(handle, variable, data, **kwargs)
+        for i, name in enumerate(dimension_names):
+            if name not in handle:
+                scale = handle.create_dataset(
+                    name, shape=(data.shape[i],), dtype=np.float64
+                )
+                scale.make_scale(name)
+            dset.dims[i].attach_scale(handle[name])
 
 
 # ----------------------------------------------------------------------------
 # CSV (reference io.py:713-1059)
 # ----------------------------------------------------------------------------
+def _scan_line_offsets(path: str, header_lines: int) -> Tuple[np.ndarray, int]:
+    """Byte offsets of each data line start (plus the end offset), scanning
+    the memory-mapped file in bounded chunks — the offset table is O(rows),
+    never the file payload (reference io.py:713-790 splits by byte ranges)."""
+    size = os.path.getsize(path)
+    offsets = [0]
+    with open(path, "rb") as f:
+        pos = 0
+        while True:
+            buf = f.read(1 << 24)
+            if not buf:
+                break
+            nl = np.flatnonzero(np.frombuffer(buf, dtype=np.uint8) == ord("\n"))
+            offsets.extend((nl + pos + 1).tolist())
+            pos += len(buf)
+    if offsets[-1] != size:
+        offsets.append(size)  # file without trailing newline
+    # drop header lines and empty trailing line starts
+    starts = offsets[header_lines:-1]
+    return np.asarray(starts + [offsets[-1]], dtype=np.int64), size
+
+
 def load_csv(
     path: str,
     header_lines: int = 0,
@@ -189,8 +340,10 @@ def load_csv(
     device=None,
     comm=None,
 ) -> DNDarray:
-    """Load a CSV file (reference io.py:713-925: byte-range splitting per rank;
-    one host read here, sharded on ingest)."""
+    """Load a CSV file (reference io.py:713-925: byte-range splitting per
+    rank). With ``split=0`` each device's row range is parsed from its own
+    byte range of the memory-mapped file; otherwise the native multithreaded
+    C++ parser (heat_tpu/_native) reads the whole file."""
     if not isinstance(path, str):
         raise TypeError(f"path must be str, but was {type(path)}")
     if not isinstance(sep, str):
@@ -198,6 +351,47 @@ def load_csv(
     if not isinstance(header_lines, int):
         raise TypeError(f"header_lines must be int, but was {type(header_lines)}")
     npdtype = np.dtype(types.canonical_heat_type(dtype).jax_type())
+    comm_obj = sanitize_comm(comm)
+    device_obj = devices_module.sanitize_device(device)
+
+    if split == 0 and encoding.lower().replace("-", "") in ("utf8", "ascii") and len(sep) == 1:
+        offs, size = _scan_line_offsets(path, header_lines)
+        # offs has one entry per data-line start + the end offset; blank
+        # trailing lines produce zero-width ranges that parse to no rows
+        with open(path, "rb") as f:
+            with mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ) as mm:
+                # determine column count from the first non-empty data line
+                ncols = None
+                for i in range(len(offs) - 1):
+                    line = bytes(mm[offs[i]:offs[i + 1]]).strip()
+                    if line:
+                        ncols = line.count(sep.encode()) + 1
+                        break
+                if ncols is None:
+                    return factories.array(
+                        np.empty((0, 0), dtype=npdtype), dtype=dtype, split=0,
+                        device=device, comm=comm,
+                    )
+                # row index of each non-empty line
+                rows = [i for i in range(len(offs) - 1)
+                        if bytes(mm[offs[i]:offs[i + 1]]).strip()]
+                gshape = (len(rows), ncols)
+
+                def read_block(sl):
+                    r0, r1 = sl[0].start, sl[0].stop
+                    if r1 <= r0:
+                        return np.empty((0, ncols), dtype=npdtype)
+                    lo, hi = offs[rows[r0]], offs[rows[r1 - 1] + 1]
+                    payload = bytes(mm[lo:hi])
+                    out = np.loadtxt(
+                        BytesIO(payload), delimiter=sep, dtype=np.float64, ndmin=2
+                    )
+                    return out.astype(npdtype, copy=False)
+
+                return _sharded_ingest(
+                    read_block, gshape, dtype, 0, device_obj, comm_obj
+                )
+
     arr = None
     if len(sep) == 1 and encoding.lower().replace("-", "") in ("utf8", "ascii"):
         # native path: multithreaded C++ byte-range parser (heat_tpu/_native)

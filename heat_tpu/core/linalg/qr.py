@@ -2,19 +2,33 @@
 
 TPU-native re-design of reference heat/core/linalg/qr.py:17-1042. The
 reference implements tile-CAQR: per-tile-column local QRs with cross-process
-Householder merges (qr.py:319-608) driven by ``SquareDiagTiles``. On TPU the
-equivalent for the dominant (tall-skinny, split=0) case is **TSQR**: each
-device QR-factors its row block, the small R factors are all-gathered and
-factored once more, and the final Q is one local matmul per device — a
-reduction tree whose only collective is a single ``all_gather`` of n×n tiles
-(SURVEY.md §7 phase 5). Column-split (split=1) inputs take a panel-wise
-blocked Householder path mirroring the reference's ``__split1_qr_loop``
-(qr.py:866-1042) with XLA resharding standing in for the panel Bcasts.
+Householder merges (qr.py:319-608 for split=0, :866-1042 for split=1) driven
+by ``SquareDiagTiles``. The TPU rendering keeps a distributed schedule for
+every split:
+
+* **split=0, m >= n** — **TSQR**: each device QR-factors its row block, the
+  small R factors are all-gathered and factored once more, and the final Q is
+  one local matmul per device. Ragged row counts ride the runtime's pad+mask
+  contract: zero row-blocks contribute zero R factors, so the padding rows of
+  Q come out exactly zero and slice off (replaces reference qr.py:319-865).
+* **split=1** — **blocked panel loop**: sequentially per device-panel, the
+  owner QR-factors its (updated) panel, broadcasts the Q panel, and all later
+  panels are orthogonalized against it with a two-pass block Gram-Schmidt
+  (CGS2) update — the reference's ``__split1_qr_loop`` Bcast-per-panel
+  schedule (qr.py:866-1042) with the Householder merge replaced by the
+  TPU-friendlier panel-QR + reorthogonalized projection. Q and R come out
+  column-split, like the reference's.
+* **split=None / short-wide (m < n with ragged rows)** — one replicated XLA
+  QR kernel; above ``_REPLICATED_MAX_ELEMENTS`` this emits a warning instead
+  of silently gathering (the reference covers these shapes with its tile
+  loops; the replicated fallback is explicit policy here, never silent).
 """
 
 from __future__ import annotations
 
 import collections
+import functools
+import warnings
 from typing import Optional, Tuple
 
 import jax
@@ -28,6 +42,10 @@ __all__ = ["qr"]
 
 QR = collections.namedtuple("QR", "Q, R")
 
+# replicated fallback (split=1 short-wide etc.) is explicit policy below this
+# size and a loud warning above it — never a silent gather
+_REPLICATED_MAX_ELEMENTS = 1 << 22
+
 
 def qr(
     a: DNDarray,
@@ -37,8 +55,8 @@ def qr(
 ) -> QR:
     """Reduced QR decomposition of a 2-D DNDarray (reference qr.py:17-179).
 
-    ``tiles_per_proc``/``overwrite_a`` are accepted for API parity; the TSQR
-    schedule has no tile-count knob and never mutates its input.
+    ``tiles_per_proc``/``overwrite_a`` are accepted for API parity; the TSQR /
+    panel schedules have no tile-count knob and never mutate their input.
     """
     sanitation.sanitize_in(a)
     if a.ndim != 2:
@@ -50,28 +68,34 @@ def qr(
     comm = a.comm
     p = comm.size
 
-    if (
-        a.split == 0
-        and p > 1
-        and m % p == 0
-        and (m // p) >= n
-    ):
-        q_arr, r_arr = _tsqr(a.larray, comm)
+    q_split = a.split
+    r_split: Optional[int] = None
+    if a.split == 0 and p > 1 and m >= n and m >= p:
+        q_arr, r_arr = _tsqr(a, comm)
+    elif a.split == 1 and p > 1 and m >= n:
+        q_arr, r_arr = _panel_qr_split1(a, comm)
+        r_split = 1
     else:
-        # replicated / column-split / short-wide: one XLA QR kernel over the
-        # (gathered) operand — the reference's split=1 loop exists to manage
-        # MPI panels, which GSPMD renders unnecessary at these shapes.
+        # replicated or short-wide: one XLA QR kernel over the gathered
+        # operand — explicit policy with a size guard, never silent
+        if a.is_distributed() and a.size > _REPLICATED_MAX_ELEMENTS:
+            warnings.warn(
+                f"qr falls back to a replicated kernel for shape {a.shape} "
+                f"split={a.split} (short-wide row-split has no distributed "
+                "schedule); consider resplit or a transpose formulation",
+                stacklevel=2,
+            )
         q_arr, r_arr = jnp.linalg.qr(a.larray, mode="reduced")
+        r_split = 1 if a.split == 1 else None
 
     q = DNDarray(
-        _ensure_split(q_arr, a.split, comm),
+        _ensure_split(q_arr, q_split, comm),
         tuple(q_arr.shape),
         types.canonical_heat_type(q_arr.dtype),
-        a.split,
+        q_split,
         a.device,
         comm,
     )
-    r_split = 1 if a.split == 1 else None
     r = DNDarray(
         _ensure_split(r_arr, r_split, comm),
         tuple(r_arr.shape),
@@ -85,33 +109,25 @@ def qr(
     return QR(q, r)
 
 
-def _tsqr(x: jax.Array, comm) -> Tuple[jax.Array, jax.Array]:
-    """Tall-skinny QR over the row-sharded global array ``x``.
-
-    Schedule (the TSQR reduction tree, replacing reference qr.py:319-865):
-      1. local QR of each (m/p, n) row block            — compute only
-      2. all_gather of the p (n, n) R factors           — one ICI collective
-      3. QR of the stacked (p*n, n) matrix (replicated) — small, redundant
-      4. local Q1 @ Q2-block                            — compute only
-    """
+@functools.lru_cache(maxsize=None)
+def _tsqr_program(mesh, axis: str, block: int, n: int, p: int, dtype_name: str):
+    """Compiled TSQR kernel over the row-padded (p*block, n) operand."""
     from jax.sharding import PartitionSpec as P
 
-    p = comm.size
-    n = x.shape[1]
-    axis = comm.axis_name
+    k1 = min(block, n)
 
-    def kernel(xs):
-        q1, r1 = jnp.linalg.qr(xs, mode="reduced")  # (m/p, n), (n, n)
-        rs = comm.allgather(r1)  # (p, n, n) — one ICI collective
-        q2, r = jnp.linalg.qr(rs.reshape(p * n, n), mode="reduced")
+    def kernel(xs):  # xs: (block, n) per device
+        q1, r1 = jnp.linalg.qr(xs, mode="reduced")  # (block, k1), (k1, n)
+        rs = jax.lax.all_gather(r1, axis)  # (p, k1, n) — the one ICI collective
+        q2, r = jnp.linalg.qr(rs.reshape(p * k1, n), mode="reduced")
         idx = jax.lax.axis_index(axis)
-        q2_block = jax.lax.dynamic_slice_in_dim(q2, idx * n, n, axis=0)  # (n, n)
+        q2_block = jax.lax.dynamic_slice_in_dim(q2, idx * k1, k1, axis=0)
         return q1 @ q2_block, r
 
-    fn = jax.jit(
+    return jax.jit(
         jax.shard_map(
             kernel,
-            mesh=comm.mesh,
+            mesh=mesh,
             in_specs=P(axis, None),
             out_specs=(P(axis, None), P(None, None)),
             # R is replicated by construction (every device factors the same
@@ -119,4 +135,98 @@ def _tsqr(x: jax.Array, comm) -> Tuple[jax.Array, jax.Array]:
             check_vma=False,
         )
     )
-    return fn(x)
+
+
+def _tsqr(a: DNDarray, comm) -> Tuple[jax.Array, jax.Array]:
+    """Tall-skinny QR over the row-sharded array (reference qr.py:319-865).
+
+    Schedule (the TSQR reduction tree):
+      1. local QR of each (block, n) row block          — compute only
+      2. all_gather of the p (k1, n) R factors          — one ICI collective
+      3. QR of the stacked (p*k1, n) matrix (replicated)— small, redundant
+      4. local Q1 @ Q2-block                            — compute only
+
+    Ragged m rides pad+mask: zero row-blocks yield zero R factors, so the
+    padded rows of Q are exactly zero and are sliced off.
+    """
+    m, n = a.shape
+    p = comm.size
+    phys = a.parray  # (p*block, n), zero rows past m
+    block = int(phys.shape[0]) // p
+    fn = _tsqr_program(comm.mesh, comm.axis_name, block, int(n), p, str(phys.dtype))
+    q_pad, r = fn(phys)
+    if a.padded:
+        q_pad = q_pad[:m]  # zero padding rows slice off
+    return q_pad, r
+
+
+@functools.lru_cache(maxsize=None)
+def _panel_program(mesh, axis: str, m: int, c: int, n: int, p: int, dtype_name: str):
+    """Compiled split=1 blocked panel-QR kernel (reference qr.py:866-1042)."""
+    from jax.sharding import PartitionSpec as P
+
+    def bcast(v, root):
+        idx = jax.lax.axis_index(axis)
+        masked = jnp.where(idx == root, v, jnp.zeros_like(v))
+        return jax.lax.psum(masked, axis)
+
+    def kernel(a_loc):  # (m, c) per device
+        idx = jax.lax.axis_index(axis)
+        q_loc = jnp.zeros_like(a_loc)
+        r_loc = jnp.zeros((n, c), a_loc.dtype)
+        a_cur = a_loc
+        for d in range(p):
+            # panel owner factors its (already orthogonalized) panel; every
+            # device computes a QR but only the owner's is broadcast — the
+            # XLA rendering of the reference's per-panel Bcast (qr.py:907-955)
+            qd_own, rd_own = jnp.linalg.qr(a_cur, mode="reduced")
+            qd = bcast(qd_own, d)  # (m, c)
+            rdd = bcast(rd_own, d)  # (c, c)
+            later = idx > d
+            # two-pass block Gram-Schmidt (CGS2) of later panels against qd
+            coef1 = qd.T @ a_cur  # (c, c)
+            a_upd = a_cur - qd @ coef1
+            coef2 = qd.T @ a_upd
+            a_upd = a_upd - qd @ coef2
+            a_cur = jnp.where(later, a_upd, a_cur)
+            # R rows d*c:(d+1)*c of this device's column block
+            r_rows = jnp.where(
+                idx == d, rdd, jnp.where(later, coef1 + coef2, jnp.zeros_like(rdd))
+            )
+            r_loc = jax.lax.dynamic_update_slice(r_loc, r_rows, (d * c, 0))
+            q_loc = jnp.where(idx == d, qd, q_loc)
+        return q_loc, r_loc
+
+    return jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=P(None, axis),
+            out_specs=(P(None, axis), P(None, axis)),
+            check_vma=False,
+        )
+    )
+
+
+def _panel_qr_split1(a: DNDarray, comm) -> Tuple[jax.Array, jax.Array]:
+    """Column-split blocked panel QR (reference qr.py:866-1042).
+
+    Sequential over the p device panels: the owner QR-factors its panel,
+    broadcasts the (m, c) Q panel, later panels run a CGS2 block update.
+    Communication: p broadcasts of (m, c) = one full-operand volume — the
+    same budget as the reference's panel Bcast schedule. Q and R come out
+    column-split. Ragged n rides pad+mask: padding columns are a suffix of
+    the last panel, their Q/R columns are sliced off at the end.
+    """
+    m, n = a.shape
+    p = comm.size
+    phys = a.parray  # (m, p*c), zero columns past n
+    c = int(phys.shape[1]) // p
+    n_pad = c * p
+    fn = _panel_program(comm.mesh, comm.axis_name, int(m), c, n_pad, p, str(phys.dtype))
+    q_pad, r_pad = fn(phys)
+    if a.padded:
+        # logical views; the DNDarray wrap re-pads along split=1
+        q_pad = q_pad[:, :n]
+        r_pad = r_pad[:n, :n]
+    return q_pad, r_pad

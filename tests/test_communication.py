@@ -353,7 +353,10 @@ class TestRoutedKernels(TestCase):
 
         qr_mod = importlib.import_module("heat_tpu.core.linalg.qr")
         src = inspect.getsource(qr_mod)
-        self.assertIn("comm.allgather", src)
+        # TSQR's single ICI collective is the R-factor all-gather (the cached
+        # program calls the lax collective directly so it can be keyed on
+        # (mesh, axis) for reuse)
+        self.assertIn("all_gather(r1", src)
 
     def test_ring_dist_uses_helpers(self):
         import inspect

@@ -1,0 +1,150 @@
+"""QR depth: oracle sweeps across all splits/shapes (incl. ragged and
+short-wide) plus HLO schedule assertions — the tall-skinny split-0 TSQR must
+not all-gather the full operand (reference heat/core/linalg/qr.py:319-1042 is
+the spec; its tile-CAQR never gathers the operand either)."""
+
+import re
+import warnings
+
+import numpy as np
+
+import heat_tpu as ht
+
+from harness import TestCase
+
+
+class TestQRAllSplits(TestCase):
+    def _check(self, m, n, split, seed=0):
+        rng = np.random.default_rng(seed)
+        a_np = rng.standard_normal((m, n))
+        a = ht.array(a_np, split=split)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            Q, R = ht.linalg.qr(a)
+        q, r = Q.numpy(), R.numpy()
+        k = min(m, n)
+        self.assertEqual(q.shape, (m, k))
+        self.assertEqual(r.shape, (k, n))
+        np.testing.assert_allclose(q @ r, a_np, atol=1e-10)
+        np.testing.assert_allclose(q.T @ q, np.eye(k), atol=1e-10)
+        self.assertLess(np.abs(np.tril(r, -1)).max(), 1e-12)
+        return Q, R
+
+    def test_tall_skinny_divisible(self):
+        p = self.get_size()
+        Q, R = self._check(8 * p, 6, 0)
+        self.assertEqual(Q.split, 0)
+        self.assertEqual(R.split, None)
+
+    def test_tall_skinny_ragged(self):
+        p = self.get_size()
+        for m in (8 * p + 1, 8 * p + p - 1, 2 * p + 1):
+            self._check(m, min(4, m), 0, seed=m)
+
+    def test_split1_all_shapes(self):
+        p = self.get_size()
+        for (m, n) in [(6 * p, 4 * p), (6 * p + 1, 2 * p + 1), (3 * p + 2, p + 1)]:
+            if m < n:
+                continue
+            Q, R = self._check(m, n, 1, seed=m * n)
+            if p > 1:
+                self.assertEqual(Q.split, 1)
+                self.assertEqual(R.split, 1)
+
+    def test_short_wide(self):
+        p = self.get_size()
+        for split in (None, 0, 1):
+            self._check(p + 1, 4 * p + 3, split, seed=11)
+
+    def test_square_and_none(self):
+        p = self.get_size()
+        self._check(4 * p, 4 * p, None)
+        self._check(4 * p, 4 * p, 0)
+
+    def test_calc_q_false(self):
+        a = ht.array(np.random.default_rng(1).standard_normal((4 * self.get_size(), 3)), split=0)
+        out = ht.linalg.qr(a, calc_q=False)
+        self.assertIsNone(out.Q)
+        self.assertEqual(out.R.shape, (3, 3))
+
+    def test_short_wide_large_warns(self):
+        p = self.get_size()
+        if p == 1:
+            self.skipTest("warning only fires for distributed operands")
+        import importlib
+
+        qr_mod = importlib.import_module("heat_tpu.core.linalg.qr")
+        old = qr_mod._REPLICATED_MAX_ELEMENTS
+        qr_mod._REPLICATED_MAX_ELEMENTS = 10
+        try:
+            a = ht.array(np.random.default_rng(2).standard_normal((p, 3 * p)), split=1)
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                ht.linalg.qr(a)
+            self.assertTrue(any("replicated" in str(x.message) for x in w))
+        finally:
+            qr_mod._REPLICATED_MAX_ELEMENTS = old
+
+    def test_int_input_promotes(self):
+        p = self.get_size()
+        a_np = np.arange(8 * p * 3).reshape(8 * p, 3)
+        a = ht.array(a_np, split=0)
+        Q, R = ht.linalg.qr(a)
+        self.assertTrue(ht.core.types.heat_type_is_inexact(Q.dtype))
+        np.testing.assert_allclose(Q.numpy() @ R.numpy(), a_np, atol=1e-8)
+
+
+class TestQRSchedule(TestCase):
+    """HLO assertions: the distributed schedules never gather the operand."""
+
+    def test_tsqr_never_gathers_operand(self):
+        p = self.get_size()
+        if p == 1:
+            self.skipTest("schedule only exists on a distributed mesh")
+        from heat_tpu.core.linalg.qr import _tsqr_program
+
+        m, n = 64 * p, 8
+        comm = self.comm
+        fn = _tsqr_program(comm.mesh, comm.axis_name, m // p, n, p, "float64")
+        import jax.numpy as jnp
+
+        hlo = fn.lower(jnp.zeros((m, n), jnp.float64)).compile().as_text()
+        # every all-gather/all-reduce in the program must move only R-tile
+        # volume (p * n * n), never the (m, n) operand
+        coll = re.findall(r"(?:all-gather|all-reduce)[^\n]*", hlo)
+        self.assertTrue(coll, "TSQR lost its R-factor all-gather")
+        for line in coll:
+            for shape in re.findall(r"f\d+\[([\d,]+)\]", line):
+                elems = int(np.prod([int(d) for d in shape.split(",")]))
+                self.assertLessEqual(
+                    elems,
+                    p * n * n,
+                    f"collective moves more than the R tiles: {line[:120]}",
+                )
+
+    def test_panel_qr_collective_budget(self):
+        p = self.get_size()
+        if p == 1:
+            self.skipTest("schedule only exists on a distributed mesh")
+        from heat_tpu.core.linalg.qr import _panel_program
+
+        m, n = 16 * p, 2 * p
+        c = n // p
+        comm = self.comm
+        fn = _panel_program(comm.mesh, comm.axis_name, m, c, n, p, "float64")
+        import jax.numpy as jnp
+
+        hlo = fn.lower(jnp.zeros((m, n), jnp.float64)).compile().as_text()
+        # each panel broadcast moves one (m, c) panel (+ its (c, c) R block),
+        # possibly fused into a single psum tuple — never the full operand
+        coll = re.findall(r"(?:all-gather|all-reduce)[^\n]*", hlo)
+        self.assertTrue(coll, "panel loop lost its broadcasts")
+        budget = m * c + c * c
+        for line in coll:
+            for shape in re.findall(r"f\d+\[([\d,]+)\]", line):
+                elems = int(np.prod([int(d) for d in shape.split(",")]))
+                self.assertLessEqual(
+                    elems,
+                    budget,
+                    f"collective moves more than one panel: {line[:120]}",
+                )

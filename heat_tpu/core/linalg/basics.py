@@ -11,11 +11,13 @@ transpose is a metadata permutation plus one resharding collective.
 
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
 
 from .. import factories, sanitation, types
 from .._operations import __binary_op as _binary_op
@@ -52,18 +54,72 @@ def _wrap_like(result: jax.Array, split: Optional[int], ref: DNDarray) -> DNDarr
     )
 
 
+@functools.lru_cache(maxsize=None)
+def _matmul_program(mesh, axis: str, a_split, b_split, out_split):
+    """Cached 2-D matmul program with explicit operand/output shardings.
+
+    Pinning the shardings hands GSPMD the whole case table of reference
+    basics.py:513-629 as one lowering problem; the emitted schedule (asserted
+    by tests/test_matmul_schedule.py) matches the reference's by case:
+    contraction-split operands -> local partials + one all-reduce of the
+    (m, n) product; split0xsplit0 / split0xsplit1 -> one all-gather of the
+    SMALLER operand (the (k, n) factor), never the row-split operand;
+    split1xsplit1 -> one all-gather of the left factor. No schedule gathers
+    more than one operand's volume.
+    """
+
+    def spec(sp):
+        if sp is None:
+            return PartitionSpec()
+        ent = [None, None]
+        ent[sp] = axis
+        return PartitionSpec(*ent)
+
+    return jax.jit(
+        jnp.matmul,
+        in_shardings=(NamedSharding(mesh, spec(a_split)), NamedSharding(mesh, spec(b_split))),
+        out_shardings=NamedSharding(mesh, spec(out_split)),
+    )
+
+
 def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
     """Matrix product of two DNDarrays (reference basics.py:424-1050).
 
     Output distribution follows the reference's case table
-    (basics.py:513-629) in spirit: a row-split left operand yields a
-    row-split product, a column-split right operand a column-split product;
-    contraction-axis splits reduce via an XLA psum.
+    (basics.py:513-629): a row-split left operand yields a row-split product,
+    a column-split right operand a column-split product; contraction-axis
+    splits reduce via an XLA psum. The 2-D divisible case runs under a cached
+    program with pinned in/out shardings so the collective schedule is
+    deterministic and asserted (tests/test_matmul_schedule.py); ragged
+    operands go through the logical view (padding must not enter the
+    contraction).
     """
     sanitation.sanitize_in(a)
     sanitation.sanitize_in(b)
     if a.ndim == 1 and b.ndim == 1:
         return dot(a, b)
+
+    if a.ndim == 2 and b.ndim == 2 and not a.padded and not b.padded:
+        # schedule-pinned path: out split per the case table; unpadded
+        # operands guarantee the out dim is divisible whenever it inherits
+        # a split from an operand
+        if a.split == 0:
+            out_split: Optional[int] = 0
+        elif b.split == 1:
+            out_split = 1
+        else:
+            out_split = None
+        fn = _matmul_program(a.comm.mesh, a.comm.axis_name, a.split, b.split, out_split)
+        result = fn(a.larray, b.larray)
+        return DNDarray(
+            result,
+            tuple(result.shape),
+            types.canonical_heat_type(result.dtype),
+            out_split,
+            a.device,
+            a.comm,
+        )
+
     result = jnp.matmul(a.larray, b.larray)
     # split bookkeeping over the matmul dimension map
     split: Optional[int] = None

@@ -367,20 +367,55 @@ class DNDarray:
         return self
 
     def get_halo(self, halo_size: int) -> None:
-        """Reference dndarray.py:360-441 exchanges split-axis boundary slices
-        with neighbor ranks. Under the global-view runtime stencil ops read
-        neighbor elements directly (XLA inserts the boundary collectives), so
-        halos are not materialized; kept as a validated no-op for parity."""
+        """Materialize split-axis boundary halos from neighbor devices
+        (reference dndarray.py:360-441: Isend/Irecv to split-axis neighbors).
+
+        The TPU rendering is one ``shard_map`` program with two
+        ``ppermute`` ring shifts: every device sends its trailing
+        ``halo_size`` slice to the next device and its leading slice to the
+        previous one; edge devices receive zeros. The received halos are
+        cached and consumed by :attr:`array_with_halos` (used by the
+        distributed ``convolve`` stencil path, signal.py)."""
         if not isinstance(halo_size, int):
             raise TypeError(f"halo_size needs to be of Python type integer, {type(halo_size)} given")
         if halo_size < 0:
             raise ValueError(f"halo_size needs to be a positive Python integer, {halo_size} given")
         self.__halo_size = halo_size
+        self.__halo_cache = None
+        if halo_size > 0 and self.__split is not None and self.__comm.size > 1:
+            phys = self.__array
+            block = int(phys.shape[self.__split]) // self.__comm.size
+            if 0 < halo_size <= block:
+                fn = _halo_program(
+                    self.__comm.mesh,
+                    self.__comm.axis_name,
+                    self.__split,
+                    halo_size,
+                    tuple(int(s) for s in phys.shape),
+                    str(phys.dtype),
+                )
+                self.__halo_cache = fn(phys)
 
     @property
     def array_with_halos(self) -> jax.Array:
-        """Global array view (halos are implicit in the global view)."""
-        return self.larray
+        """The physical payload with each device's shard extended by the
+        halos exchanged in :meth:`get_halo` (reference dndarray.py:332-341):
+        a global array of shape ``p * (block + 2*halo)`` along the split axis
+        where every device holds ``[from_prev | local | from_next]``. Without
+        materialized halos this is the logical global view."""
+        halos = getattr(self, "_DNDarray__halo_cache", None)
+        if halos is None:
+            return self.larray
+        from_prev, from_next = halos
+        fn = _halo_concat_program(
+            self.__comm.mesh,
+            self.__comm.axis_name,
+            self.__split,
+            tuple(int(s) for s in self.__array.shape),
+            tuple(int(s) for s in from_prev.shape),
+            str(self.__array.dtype),
+        )
+        return fn(from_prev, self.__array, from_next)
 
     @property
     def halo_prev(self) -> Optional[jax.Array]:
@@ -805,6 +840,62 @@ def _key_ndim(k) -> int:
     if isinstance(k, list):
         return np.asarray(k).ndim
     return k.ndim
+
+
+@functools.lru_cache(maxsize=None)
+def _halo_program(mesh, axis: str, split: int, h: int, pshape, dtype_name: str):
+    """Cached halo-exchange program: two ppermute ring shifts returning the
+    (from_prev, from_next) halo slices per device; edge devices get zeros
+    (the TPU rendering of reference dndarray.py:360-441)."""
+    from jax.sharding import PartitionSpec
+
+    p = mesh.devices.size
+    block = pshape[split] // p
+
+    def spec():
+        ent = [None] * len(pshape)
+        ent[split] = axis
+        return PartitionSpec(*ent)
+
+    def kernel(x):  # local shard: block along split
+        lead = jax.lax.slice_in_dim(x, 0, h, axis=split)
+        trail = jax.lax.slice_in_dim(x, block - h, block, axis=split)
+        # device d+1 receives d's trailing slice; device d-1 receives d's
+        # leading slice; unaddressed edges receive zeros
+        from_prev = jax.lax.ppermute(trail, axis, [(j, j + 1) for j in range(p - 1)])
+        from_next = jax.lax.ppermute(lead, axis, [(j, j - 1) for j in range(1, p)])
+        return from_prev, from_next
+
+    return jax.jit(
+        jax.shard_map(
+            kernel, mesh=mesh, in_specs=spec(), out_specs=(spec(), spec()), check_vma=False
+        )
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _halo_concat_program(mesh, axis: str, split: int, pshape, hshape, dtype_name: str):
+    """Cached per-device ``[from_prev | local | from_next]`` concatenation
+    along the split axis (reference array_with_halos, dndarray.py:332-341)."""
+    from jax.sharding import PartitionSpec
+
+    def spec():
+        ent = [None] * len(pshape)
+        ent[split] = axis
+        return PartitionSpec(*ent)
+
+    def kernel(prev, x, nxt):
+        return jnp.concatenate([prev, x, nxt], axis=split)
+
+    return jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=(spec(), spec(), spec()),
+            out_specs=spec(),
+            check_vma=False,
+        )
+    )
 
 
 @functools.lru_cache(maxsize=None)

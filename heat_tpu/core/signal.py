@@ -2,12 +2,18 @@
 
 The reference's distributed 1-D convolution exchanges halos between
 split-axis neighbors (signal.py:86-130 via dndarray.get_halo :360-441) and
-then runs a local conv1d. Under the global view, one sharded XLA convolution
-covers both steps: GSPMD inserts the boundary collective-permutes the halo
-exchange performed by hand in the reference.
+then runs a local conv1d. The TPU rendering keeps exactly that schedule for
+the block-aligned case: ``a.get_halo(k//2)`` materializes the neighbor halos
+via ppermute (dndarray._halo_program), and a ``shard_map`` kernel runs one
+*local* valid-mode convolution per device over ``array_with_halos`` — the
+halo exchange is the only communication. Other cases (even kernels, ragged
+or replicated inputs, halo wider than a block) run one global XLA
+convolution instead.
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -16,6 +22,27 @@ from . import factories, sanitation, types
 from .dndarray import DNDarray, _ensure_split
 
 __all__ = ["convolve"]
+
+
+@functools.lru_cache(maxsize=None)
+def _halo_conv_program(mesh, axis: str, ext: int, k: int, dtype_name: str):
+    """Cached local valid-conv kernel over halo-extended shards: each device
+    convolves its ``[prev | local | next]`` slab, producing exactly its own
+    ``block`` outputs (overlap-save; reference signal.py:86-130)."""
+    from jax.sharding import PartitionSpec as P
+
+    def kernel(x_ext, v):  # (ext,), (k,) -> (ext - k + 1,)
+        return jnp.convolve(x_ext, v, mode="valid")
+
+    return jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=(P(axis), P()),
+            out_specs=P(axis),
+            check_vma=False,
+        )
+    )
 
 
 def convolve(a, v, mode: str = "full") -> DNDarray:
@@ -36,6 +63,41 @@ def convolve(a, v, mode: str = "full") -> DNDarray:
     promoted = types.promote_types(a.dtype, v.dtype)
     if types.heat_type_is_exact(promoted):
         promoted = types.promote_types(promoted, types.float32)
+    k = v.shape[0]
+    n = a.shape[0]
+    p = a.comm.size
+
+    # distributed stencil path (reference signal.py:86-130): odd kernel,
+    # same-mode, block-aligned row split — halo exchange + local conv only
+    if (
+        mode == "same"
+        and k % 2 == 1
+        and a.split == 0
+        and p > 1
+        and not a.padded
+        and n % p == 0
+        and k // 2 <= n // p
+        and k // 2 > 0
+    ):
+        if a.dtype is not promoted:
+            a = a.astype(promoted)
+        vl = v.larray.astype(promoted.jax_type())
+        h = k // 2
+        a.get_halo(h)
+        ext_global = a.array_with_halos  # (p * (block + 2h),)
+        fn = _halo_conv_program(
+            a.comm.mesh, a.comm.axis_name, n // p + 2 * h, k, str(ext_global.dtype)
+        )
+        result = fn(ext_global, vl)
+        return DNDarray(
+            result,
+            tuple(result.shape),
+            types.canonical_heat_type(result.dtype),
+            0,
+            a.device,
+            a.comm,
+        )
+
     al = a.larray.astype(promoted.jax_type())
     vl = v.larray.astype(promoted.jax_type())
     result = jnp.convolve(al, vl, mode=mode)

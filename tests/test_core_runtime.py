@@ -278,9 +278,11 @@ class TestDNDarray(TestCase):
                 x.redistribute_(target_map=ragged)
 
     def test_halo_api(self):
-        x = ht.arange(8, split=0)
+        p = self.get_size()
+        x = ht.arange(8 * p, split=0)
         x.get_halo(1)
-        self.assertEqual(x.array_with_halos.shape, (8,))
+        # each device's shard is extended by one halo element per side
+        self.assertEqual(x.array_with_halos.shape, ((8 + 2) * p if p > 1 else 8 * p,))
         with pytest.raises(TypeError):
             x.get_halo("a")
         with pytest.raises(ValueError):

@@ -1,0 +1,123 @@
+"""Halo exchange: materialized neighbor slices + the convolve stencil
+consumer (reference heat/core/dndarray.py:360-441 Isend/Irecv halos and
+heat/core/signal.py:86-130 halo-consuming conv1d). Pins halo content per
+device (zeros at the edges), the schedule (ppermute only — no gather), and
+the distributed same-mode convolution built on it."""
+
+import re
+
+import numpy as np
+
+import heat_tpu as ht
+
+from harness import TestCase
+
+
+class TestHaloExchange(TestCase):
+    def test_halo_content_per_device(self):
+        p = self.get_size()
+        if p == 1:
+            self.skipTest("halos need neighbors")
+        block, h = 6, 2
+        n = block * p
+        a_np = np.arange(n, dtype=np.float64)
+        a = ht.array(a_np, split=0)
+        a.get_halo(h)
+        ext = np.asarray(a.array_with_halos).reshape(p, block + 2 * h)
+        for d in range(p):
+            lo, hi = d * block - h, (d + 1) * block + h
+            expect = np.zeros(block + 2 * h)
+            s, e = max(lo, 0), min(hi, n)
+            expect[s - lo : s - lo + (e - s)] = a_np[s:e]
+            np.testing.assert_array_equal(ext[d], expect, err_msg=f"device {d}")
+
+    def test_halo_2d_split0(self):
+        p = self.get_size()
+        if p == 1:
+            self.skipTest("halos need neighbors")
+        a_np = np.arange(4 * p * 3, dtype=np.float64).reshape(4 * p, 3)
+        a = ht.array(a_np, split=0)
+        a.get_halo(1)
+        ext = np.asarray(a.array_with_halos).reshape(p, 6, 3)
+        np.testing.assert_array_equal(ext[0, 0], np.zeros(3))  # edge zeros
+        if p > 1:
+            np.testing.assert_array_equal(ext[1, 0], a_np[4 * 1 - 1])  # prev halo
+
+    def test_halo_schedule_is_ppermute_only(self):
+        p = self.get_size()
+        if p == 1:
+            self.skipTest("halos need neighbors")
+        from heat_tpu.core.dndarray import _halo_program
+
+        import jax
+        import jax.numpy as jnp
+
+        comm = self.comm
+        fn = _halo_program(comm.mesh, comm.axis_name, 0, 2, (8 * p,), "float64")
+        hlo = fn.lower(jax.ShapeDtypeStruct((8 * p,), jnp.float64)).compile().as_text()
+        self.assertIn("collective-permute", hlo)
+        self.assertNotIn("all-gather", hlo)
+        self.assertNotIn("all-reduce", hlo)
+
+    def test_halo_too_wide_falls_back(self):
+        p = self.get_size()
+        a = ht.arange(2 * p, split=0)
+        a.get_halo(5)  # wider than the block: no materialization
+        self.assertEqual(a.array_with_halos.shape, (2 * p,))
+
+
+class TestConvolveHalo(TestCase):
+    def test_same_mode_matches_numpy(self):
+        p = self.get_size()
+        rng = np.random.default_rng(0)
+        a_np = rng.standard_normal(16 * p)
+        for k in (3, 5, 7):
+            v_np = rng.standard_normal(k)
+            out = ht.convolve(ht.array(a_np, split=0), ht.array(v_np), mode="same")
+            self.assertEqual(out.split, 0)
+            np.testing.assert_allclose(out.numpy(), np.convolve(a_np, v_np, "same"), atol=1e-12)
+
+    def test_same_mode_schedule(self):
+        # the halo path's only communication is the ppermute halo exchange
+        p = self.get_size()
+        if p == 1:
+            self.skipTest("needs a distributed mesh")
+        from heat_tpu.core.signal import _halo_conv_program
+
+        import jax
+        import jax.numpy as jnp
+
+        comm = self.comm
+        block, k = 16, 5
+        fn = _halo_conv_program(comm.mesh, comm.axis_name, block + 4, k, "float64")
+        hlo = (
+            fn.lower(
+                jax.ShapeDtypeStruct(((block + 4) * p,), jnp.float64),
+                jax.ShapeDtypeStruct((k,), jnp.float64),
+            )
+            .compile()
+            .as_text()
+        )
+        self.assertNotIn("all-gather", hlo)
+        self.assertNotIn("all-reduce", hlo)
+
+    def test_all_modes_all_splits_oracle(self):
+        rng = np.random.default_rng(1)
+        p = self.get_size()
+        for n in (8 * p, 8 * p + 3):
+            a_np = rng.standard_normal(n)
+            for k in (2, 3, 6, 7):
+                v_np = rng.standard_normal(k)
+                for mode in ("full", "same", "valid"):
+                    if mode == "same" and k % 2 == 0:
+                        continue
+                    for split in (None, 0):
+                        out = ht.convolve(
+                            ht.array(a_np, split=split), ht.array(v_np), mode=mode
+                        )
+                        np.testing.assert_allclose(
+                            out.numpy(),
+                            np.convolve(a_np, v_np, mode),
+                            atol=1e-12,
+                            err_msg=f"n={n} k={k} mode={mode} split={split}",
+                        )

@@ -1,20 +1,53 @@
-"""Iterative solvers (reference: heat/core/linalg/solver.py).
+"""Solvers (reference: heat/core/linalg/solver.py).
 
-Both are compositions of matmul/dot exactly as in the reference; the manual
-Allreduce dots (solver.py:13-184) are sharded reductions here.
+``cg``/``lanczos`` are compositions of matmul/dot exactly as in the
+reference; the manual Allreduce dots (solver.py:13-184) are sharded
+reductions here. ``cg`` is fused into ONE XLA program (``lax.while_loop``
+with the convergence test on device) — the reference's Python loop
+host-syncs every iteration; the fused loop dispatches once.
+``solve_triangular`` is the blocked back/forward-substitution driven by the
+``SquareDiagTiles`` decomposition (the tile grid the reference builds for
+its tile-QR, reference tiling.py:331-1257).
 """
 
 from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from .. import factories
 from ..dndarray import DNDarray
 from .basics import dot, matmul, norm, transpose
 
-__all__ = ["cg", "lanczos"]
+__all__ = ["cg", "lanczos", "solve_triangular"]
+
+
+@jax.jit
+def _cg_fused(Al, bl, x0l):
+    """Whole CG run as one XLA program: the convergence test lives on device
+    inside the while_loop, so there is no per-iteration host round-trip."""
+    n = bl.shape[0]
+    r0 = bl - Al @ x0l
+    rs0 = r0 @ r0
+
+    def cond(carry):
+        _, _, _, rsold, i = carry
+        return (i < n) & (jnp.sqrt(rsold) >= 1e-10)
+
+    def body(carry):
+        x, r, p, rsold, i = carry
+        Ap = Al @ p
+        alpha = rsold / (p @ Ap)
+        x = x + alpha * p
+        r = r - alpha * Ap
+        rsnew = r @ r
+        p = r + (rsnew / rsold) * p
+        return x, r, p, rsnew, i + 1
+
+    x, _, _, _, _ = jax.lax.while_loop(cond, body, (x0l, r0, r0, rs0, jnp.int32(0)))
+    return x
 
 
 def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -> DNDarray:
@@ -28,29 +61,62 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
     if x0.ndim != 1:
         raise RuntimeError("c needs to be a 1D vector")
 
-    r = b - matmul(A, x0)
-    p = r
-    rsold = dot(r, r)
-    x = x0
-
-    for i in range(len(b)):
-        Ap = matmul(A, p)
-        alpha = rsold / dot(p, Ap)
-        x = x + alpha * p
-        r = r - alpha * Ap
-        rsnew = dot(r, r)
-        if float(jnp.sqrt(rsnew.larray)) < 1e-10:
-            if out is not None:
-                out._replace(x.larray, x.split)
-                return out
-            return x
-        p = r + ((rsnew / rsold) * p)
-        rsold = rsnew
-
+    xl = _cg_fused(A.larray, b.larray, x0.larray)
+    x = factories.array(xl, is_split=None, device=x0.device, comm=x0.comm)
+    x.resplit_(x0.split)
     if out is not None:
         out._replace(x.larray, x.split)
         return out
     return x
+
+
+def solve_triangular(A: DNDarray, b: DNDarray, lower: bool = False) -> DNDarray:
+    """Solve ``A x = b`` for triangular ``A`` by blocked substitution over
+    the :class:`~heat_tpu.core.tiling.SquareDiagTiles` decomposition.
+
+    The tile grid supplies the diagonal-aligned block bounds (the same
+    decomposition the reference builds to drive tile-QR, reference
+    tiling.py:331-1257); the sweep solves one diagonal tile with the XLA
+    triangular kernel and folds the off-diagonal tiles into the right-hand
+    side — MXU matmuls between small triangular solves.
+    """
+    from ..tiling import SquareDiagTiles
+
+    if not isinstance(A, DNDarray) or not isinstance(b, DNDarray):
+        raise TypeError("A and b must be DNDarrays")
+    if A.ndim != 2 or A.shape[0] != A.shape[1]:
+        raise ValueError("A must be a square 2-D matrix")
+    vector_rhs = b.ndim == 1
+    if b.shape[0] != A.shape[0]:
+        raise ValueError("b's leading dimension must match A")
+
+    tiles = SquareDiagTiles(A, tiles_per_proc=2)
+    # global tile-row boundaries from the decomposition's index arithmetic
+    bounds = [0] + [int(t) for t in tiles.row_indices[1:]] + [A.shape[0]]
+    bounds = sorted(set(bounds))
+
+    Al = A.larray.astype(jnp.result_type(A.larray.dtype, jnp.float32))
+    bl = b.larray.astype(Al.dtype)
+    if vector_rhs:
+        bl = bl[:, None]
+    x = jnp.zeros_like(bl)
+
+    spans = list(zip(bounds[:-1], bounds[1:]))
+    order = spans if lower else list(reversed(spans))
+    for (s, e) in order:
+        rhs = bl[s:e]
+        if lower:
+            rhs = rhs - Al[s:e, :s] @ x[:s]
+        else:
+            rhs = rhs - Al[s:e, e:] @ x[e:]
+        blk = jax.scipy.linalg.solve_triangular(Al[s:e, s:e], rhs, lower=lower)
+        x = x.at[s:e].set(blk)
+
+    if vector_rhs:
+        x = x[:, 0]
+    out = factories.array(x, device=b.device, comm=b.comm)
+    out.resplit_(b.split)
+    return out
 
 
 def lanczos(

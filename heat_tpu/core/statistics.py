@@ -74,6 +74,37 @@ def argmin(x: DNDarray, axis: Optional[int] = None, out=None, **kwargs) -> DNDar
 def _arg_reduce(op, x, axis, out):
     sanitation.sanitize_in(x)
     axis = sanitize_axis(x.shape, axis)
+    # distributed schedule for a reduction ACROSS the split axis: local
+    # (value, global-index) partials merged with the mpi_argmax/mpi_argmin
+    # combiner through one allreduce — the reference's custom MPI reduce op
+    # (reference statistics.py:1335-1405) riding MeshCommunication.allreduce.
+    if (
+        isinstance(axis, int)
+        and x.split == axis
+        and not x.padded
+        and x.comm.size > 1
+    ):
+        import jax
+
+        comm = x.comm
+        combiner = mpi_argmax if op is jnp.argmax else mpi_argmin
+        block = x.shape[axis] // comm.size
+
+        def kernel(xs):
+            lv = (jnp.max if op is jnp.argmax else jnp.min)(xs, axis=axis)
+            li = op(xs, axis=axis) + jax.lax.axis_index(comm.axis_name) * block
+            _, gi = comm.allreduce((lv, li), op=combiner)
+            return gi
+
+        result = comm.apply(kernel, x.larray, in_splits=[axis], out_splits=None)
+        result = result.astype(types.index_dtype())
+        split = None
+        ret = _wrap(result, split, x)
+        if out is not None:
+            sanitation.sanitize_out(out, ret.shape, ret.split, ret.device)
+            out._replace(ret.larray.astype(out.dtype.jax_type()), ret.split)
+            return out
+        return ret
     result = op(x.larray, axis=axis).astype(types.index_dtype())
     if axis is None:
         split = None

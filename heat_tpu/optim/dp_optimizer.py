@@ -134,13 +134,19 @@ class DASO:
         self.verbose = verbose
         self.downcast_type = downcast_type
 
-        # skip schedule state (reference dp_optimizer.py:60-66)
+        # skip schedule state (reference dp_optimizer.py:60-66).
+        # local_skip drives the ICI sync cadence (reference :432-475): while
+        # local-skipping, devices inside a DCN group step independently (no
+        # gradient allreduce); every local_skip-th batch re-averages params
+        # over ICI and syncs gradients again.
         self.global_skip = 0
         self.local_skip = 0
+        self.local_skip_factor = int(local_skip_factor)
         self.batches_to_wait = 0
         self.epoch = 0
         self.current_batch = 0
         self._send_mod = skip_batches
+        self._solo_steps = 0  # observability: batches stepped without ICI sync
 
         self.stability = DetectMetricPlateau(
             patience=2, threshold=stability_level, threshold_mode="rel"
@@ -169,19 +175,21 @@ class DASO:
         if self._stateful:
             params = variables["params"]
             self.state = jax.tree.map(
-                lambda a: jnp.broadcast_to(a, (self.nodes,) + a.shape),
+                lambda a: jnp.broadcast_to(a, (self.nodes * self.ici_size,) + a.shape),
                 {k: v for k, v in variables.items() if k != "params"},
             )
         else:
             params = variables
-        # replicate params per dcn group: leading axis sharded over 'dcn'
+        # one replica per DEVICE (leading axis over the flattened dcn x ici
+        # mesh): replicas inside a group may diverge while local-skipping —
+        # the reference's local_skip semantics (dp_optimizer.py:432-475)
+        n_dev = self.nodes * self.ici_size
         self.params = jax.tree.map(
-            lambda a: jnp.broadcast_to(a, (self.nodes,) + a.shape), params
+            lambda a: jnp.broadcast_to(a, (n_dev,) + a.shape), params
         )
-        # one optimizer state per group, same leading-axis layout
         single_opt_state = self.local_optimizer.init(params)
         self.opt_state = jax.tree.map(
-            lambda a: jnp.broadcast_to(jnp.asarray(a), (self.nodes,) + jnp.shape(a)),
+            lambda a: jnp.broadcast_to(jnp.asarray(a), (n_dev,) + jnp.shape(a)),
             single_opt_state,
         )
         self._build()
@@ -189,10 +197,10 @@ class DASO:
         return self
 
     def _spec_grouped(self):
-        return P("dcn")
+        return P(("dcn", "ici"))
 
     def _place(self):
-        grouped = NamedSharding(self.mesh, P("dcn"))
+        grouped = NamedSharding(self.mesh, P(("dcn", "ici")))
         self.params = jax.tree.map(lambda a: jax.device_put(a, grouped), self.params)
         self.opt_state = jax.tree.map(
             lambda a: jax.device_put(jnp.asarray(a), grouped) if hasattr(a, "shape") else a,
@@ -208,47 +216,59 @@ class DASO:
         loss_fn = self.loss_fn
         stateful = self._stateful
 
-        group_spec = P("dcn")
+        group_spec = P(("dcn", "ici"))
         batch_spec = P(("dcn", "ici"))
 
-        def local_step(params, state, opt_state, x, y):
-            """One batch: grads averaged over 'ici' only; each dcn group
-            evolves independently (reference dp_optimizer.py:432-475)."""
+        def make_local_step(sync_ici: bool):
+            """One batch. ``sync_ici=True`` is the reference's synced batch:
+            params are re-averaged over ICI (a no-op when replicas agree,
+            the re-convergence sync after a local-skip window) and gradients
+            ride the torch-DDP-style ICI allreduce. ``sync_ici=False`` is a
+            local-skip batch (reference dp_optimizer.py:432-475): every
+            device steps its own replica with no ICI traffic at all."""
 
-            def kernel(p, s, o, xb, yb):
-                # inside shard_map: p/s/o are this group's replicas, xb this
-                # device's batch shard
-                p = jax.tree.map(lambda a: a[0], p)
-                o = jax.tree.map(lambda a: a[0], o)
+            def local_step(params, state, opt_state, x, y):
+                def kernel(p, s, o, xb, yb):
+                    # inside shard_map: p/s/o are THIS device's replica
+                    p = jax.tree.map(lambda a: a[0], p)
+                    o = jax.tree.map(lambda a: a[0], o)
+                    if sync_ici:
+                        p = jax.lax.pmean(p, "ici")
 
-                def loss_of(pp):
+                    def loss_of(pp):
+                        if stateful:
+                            s0 = jax.tree.map(lambda a: a[0], s)
+                            out, new_s = module.apply(
+                                {"params": pp, **s0}, xb, train=True, mutable=["batch_stats"]
+                            )
+                            return loss_fn(out, yb), new_s
+                        return loss_fn(module.apply(pp, xb), yb), None
+
+                    (loss, new_s), grads = jax.value_and_grad(loss_of, has_aux=True)(p)
+                    if sync_ici:
+                        # ICI gradient sync (the torch-DDP allreduce)
+                        grads = jax.lax.pmean(grads, "ici")
+                    loss = jax.lax.pmean(loss, ("dcn", "ici"))
+                    updates, o = opt.update(grads, o, p)
+                    p = optax.apply_updates(p, updates)
+                    expand = lambda t: jax.tree.map(lambda a: a[None], t)
                     if stateful:
-                        s0 = jax.tree.map(lambda a: a[0], s)
-                        out, new_s = module.apply(
-                            {"params": pp, **s0}, xb, train=True, mutable=["batch_stats"]
+                        new_s = expand(
+                            jax.lax.pmean(new_s, "ici") if sync_ici else new_s
                         )
-                        return loss_fn(out, yb), new_s
-                    return loss_fn(module.apply(pp, xb), yb), None
+                    else:
+                        new_s = s
+                    return expand(p), new_s, expand(o), loss
 
-                (loss, new_s), grads = jax.value_and_grad(loss_of, has_aux=True)(p)
-                # ICI gradient sync (the torch-DDP allreduce of the reference)
-                grads = jax.lax.pmean(grads, "ici")
-                loss = jax.lax.pmean(loss, ("dcn", "ici"))
-                updates, o = opt.update(grads, o, p)
-                p = optax.apply_updates(p, updates)
-                expand = lambda t: jax.tree.map(lambda a: a[None], t)
-                new_s = (
-                    expand(jax.lax.pmean(new_s, "ici")) if stateful else s
-                )
-                return expand(p), new_s, expand(o), loss
+                return jax.shard_map(
+                    kernel,
+                    mesh=mesh,
+                    in_specs=(group_spec, group_spec, group_spec, batch_spec, batch_spec),
+                    out_specs=(group_spec, group_spec, group_spec, P()),
+                    check_vma=False,
+                )(params, state, opt_state, x, y)
 
-            return jax.shard_map(
-                kernel,
-                mesh=mesh,
-                in_specs=(group_spec, group_spec, group_spec, batch_spec, batch_spec),
-                out_specs=(group_spec, group_spec, group_spec, P()),
-                check_vma=False,
-            )(params, state, opt_state, x, y)
+            return local_step
 
         def global_merge(params, waits):
             """Stale-weighted DCN merge (reference dp_optimizer.py:501-589):
@@ -259,7 +279,7 @@ class DASO:
             def kernel(p):
                 local = jax.tree.map(lambda a: a[0], p)
                 wire = jax.tree.map(lambda a: a.astype(self.downcast_type), local)
-                gmean = jax.lax.pmean(wire, "dcn")
+                gmean = jax.lax.pmean(wire, ("dcn", "ici"))
                 merged = jax.tree.map(
                     lambda g, l: ((g.astype(l.dtype) + waits * l) / (waits + 1.0)),
                     gmean,
@@ -275,7 +295,8 @@ class DASO:
                 check_vma=False,
             )(params)
 
-        self._local_step = jax.jit(local_step)
+        self._local_step = jax.jit(make_local_step(sync_ici=True))
+        self._local_step_solo = jax.jit(make_local_step(sync_ici=False))
         self._global_merge = jax.jit(global_merge)
 
     # ------------------------------------------------------------------
@@ -309,7 +330,16 @@ class DASO:
         xb = jax.device_put(xj, batch_sh)
         yb = jax.device_put(yj, batch_sh)
         state = self.state if self.state is not None else {}
-        self.params, new_state, self.opt_state, loss = self._local_step(
+        # local-skip cadence (reference dp_optimizer.py:432-475): between
+        # ICI syncs each device steps its own replica with zero collective
+        # traffic; every local_skip-th batch re-averages params over ICI and
+        # syncs gradients again
+        ls = self._effective_local_skip()
+        solo = ls > 1 and (self.current_batch % ls) != 0
+        step_fn = self._local_step_solo if solo else self._local_step
+        if solo:
+            self._solo_steps += 1
+        self.params, new_state, self.opt_state, loss = step_fn(
             self.params, state, self.opt_state, xb, yb
         )
         if self._stateful:
@@ -329,6 +359,15 @@ class DASO:
             return 0
         return self.global_skip
 
+    def _effective_local_skip(self) -> int:
+        """ICI sync cadence: always synced during warmup/cooldown, the
+        scheduled ``local_skip`` during the cycling phase."""
+        if self.epoch < self.warmup_epochs:
+            return 0
+        if self.epoch >= self.total_epochs - self.cooldown_epochs:
+            return 0
+        return self.local_skip
+
     def epoch_loss_logic(self, loss, loss_globally_averaged: bool = False) -> None:
         """End-of-epoch schedule update (reference dp_optimizer.py:336-431):
         entering the cycling phase starts at max skips; a loss plateau halves
@@ -338,7 +377,7 @@ class DASO:
         self.current_batch = 0
         if self.epoch == self.warmup_epochs:
             self.global_skip = 4
-            self.local_skip = 1
+            self.local_skip = max(1, 4 // self.local_skip_factor)
             self.batches_to_wait = 1
             self._print0(f"warmup done; global_skips={self.global_skip}")
             return
@@ -346,12 +385,15 @@ class DASO:
             return
         stable = self.stability.test_if_improving(loss_val)
         if stable and self.global_skip > 1:
-            # loss stopped improving -> tighten synchronization
+            # loss stopped improving -> tighten synchronization (local skips
+            # halve together with global skips, reference dp_optimizer.py:377-409)
             self.global_skip //= 2
+            self.local_skip = max(1, self.local_skip // 2)
             self.batches_to_wait = max(self.batches_to_wait // 2, 1)
             self._print0(f"loss plateau; global_skips -> {self.global_skip}")
         elif self.global_skip == 1 and stable:
             self.global_skip = min(self.max_gs, 4)
+            self.local_skip = max(1, self.global_skip // self.local_skip_factor)
             self.batches_to_wait = 1
             self.stability.reset()
             self._print0(f"resetting skips upward -> {self.global_skip}")
@@ -381,8 +423,8 @@ class DASO:
     # round-trips through heat_tpu.utils.checkpoint)
     # ------------------------------------------------------------------
     def state_dict(self):
-        """Full resumable state. Restoring requires the same ``nodes`` layout
-        (params carry the leading dcn-group axis)."""
+        """Full resumable state. Restoring requires the same mesh layout
+        (params carry a leading per-device replica axis)."""
         return {
             "params": self.params,
             "state": self.state if self.state is not None else {},

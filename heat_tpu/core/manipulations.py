@@ -280,13 +280,16 @@ def moveaxis(x: DNDarray, source, destination) -> DNDarray:
 
 
 def pad(array: DNDarray, pad_width, mode: str = "constant", constant_values=0) -> DNDarray:
-    """Pad an array (reference manipulations.py:1128-1458)."""
+    """Pad an array (reference manipulations.py:1128-1458). Supports numpy's
+    pad modes ('constant', 'edge', 'reflect', 'symmetric', 'wrap', ...) via
+    the XLA pad/gather kernels."""
     sanitation.sanitize_in(array)
-    if mode != "constant":
-        raise NotImplementedError(f"Only mode 'constant' is supported, got {mode}")
     if isinstance(pad_width, DNDarray):
         pad_width = pad_width.tolist()
-    result = jnp.pad(array.larray, pad_width, mode=mode, constant_values=constant_values)
+    if mode == "constant":
+        result = jnp.pad(array.larray, pad_width, mode=mode, constant_values=constant_values)
+    else:
+        result = jnp.pad(array.larray, pad_width, mode=mode)
     return _wrap(result, array.split, array)
 
 

@@ -1,0 +1,308 @@
+"""Edge-case depth sweeps modeled on the reference's deep suites
+(reference heat/core/tests/test_manipulations.py and test_dndarray.py):
+mixed splits/dtypes in concatenate, pad modes, repeat, unique with axis,
+getitem/setitem semantics, reshape with new_split, and communication
+helpers over transposed/non-contiguous inputs. Non-divisible shapes are
+woven through every group (they exercise the pad+mask core)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from harness import TestCase
+
+
+class TestConcatenateDepth(TestCase):
+    def _n(self):
+        return 2 * self.get_size() + 1  # always ragged on p>1
+
+    def test_mixed_splits(self):
+        n = self._n()
+        a_np = np.arange(n * 3, dtype=np.float64).reshape(n, 3)
+        b_np = -np.arange(n * 3, dtype=np.float64).reshape(n, 3)
+        expect = np.concatenate([a_np, b_np], axis=0)
+        for sa in (None, 0, 1):
+            for sb in (None, 0, 1):
+                out = ht.concatenate([ht.array(a_np, split=sa), ht.array(b_np, split=sb)], axis=0)
+                np.testing.assert_array_equal(out.numpy(), expect)
+
+    def test_mixed_dtypes_promote(self):
+        a = ht.arange(6, dtype=ht.int32, split=0)
+        b = ht.arange(6, dtype=ht.float64, split=0)
+        out = ht.concatenate([a, b])
+        self.assertEqual(out.dtype, ht.float64)
+        np.testing.assert_array_equal(out.numpy(), np.r_[np.arange(6), np.arange(6.0)])
+
+    def test_axis1_and_three_arrays(self):
+        n = self._n()
+        parts = [np.full((n, i + 1), i, dtype=np.float32) for i in range(3)]
+        out = ht.concatenate([ht.array(p, split=0) for p in parts], axis=1)
+        np.testing.assert_array_equal(out.numpy(), np.concatenate(parts, axis=1))
+        self.assertEqual(out.split, 0)
+
+    def test_errors(self):
+        with self.assertRaises((ValueError, TypeError)):
+            ht.concatenate([ht.ones((2, 3)), ht.ones((3, 4))], axis=0)
+        with self.assertRaises((ValueError, TypeError, IndexError)):
+            ht.concatenate([ht.ones(3), ht.ones(3)], axis=2)
+
+    def test_stack_variants(self):
+        n = self._n()
+        a_np = np.arange(n, dtype=np.float64)
+        a = ht.array(a_np, split=0)
+        np.testing.assert_array_equal(ht.vstack([a, a]).numpy(), np.vstack([a_np, a_np]))
+        np.testing.assert_array_equal(ht.hstack([a, a]).numpy(), np.hstack([a_np, a_np]))
+        np.testing.assert_array_equal(
+            ht.column_stack([a, a]).numpy(), np.column_stack([a_np, a_np])
+        )
+        np.testing.assert_array_equal(ht.row_stack([a, a]).numpy(), np.row_stack([a_np, a_np]))
+        np.testing.assert_array_equal(
+            ht.stack([a, a], axis=1).numpy(), np.stack([a_np, a_np], axis=1)
+        )
+
+
+class TestPadModes(TestCase):
+    def test_all_modes_1d(self):
+        n = 2 * self.get_size() + 1
+        a_np = np.arange(1, n + 1, dtype=np.float64)
+        a = ht.array(a_np, split=0)
+        for mode in ("constant", "edge", "reflect", "symmetric", "wrap"):
+            kw = {"constant_values": 7} if mode == "constant" else {}
+            out = ht.pad(a, (2, 3), mode=mode, **kw)
+            np.testing.assert_array_equal(
+                out.numpy(),
+                np.pad(a_np, (2, 3), mode=mode, **kw),
+                err_msg=mode,
+            )
+
+    def test_2d_per_axis_widths(self):
+        a_np = np.arange(12, dtype=np.float32).reshape(3, 4)
+        for split in (None, 0, 1):
+            a = ht.array(a_np, split=split)
+            out = ht.pad(a, ((1, 0), (0, 2)), mode="constant", constant_values=-1)
+            np.testing.assert_array_equal(
+                out.numpy(), np.pad(a_np, ((1, 0), (0, 2)), constant_values=-1)
+            )
+            self.assertEqual(out.split, split)
+
+    def test_int_width(self):
+        a = ht.arange(5, split=0)
+        np.testing.assert_array_equal(ht.pad(a, 2).numpy(), np.pad(np.arange(5), 2))
+
+
+class TestRepeatDepth(TestCase):
+    def test_scalar_repeats(self):
+        n = 2 * self.get_size() + 1
+        a_np = np.arange(n, dtype=np.int64)
+        a = ht.array(a_np, split=0)
+        np.testing.assert_array_equal(ht.repeat(a, 3).numpy(), np.repeat(a_np, 3))
+
+    def test_axis_and_2d(self):
+        a_np = np.arange(6, dtype=np.float64).reshape(2, 3)
+        for split in (None, 0, 1):
+            a = ht.array(a_np, split=split)
+            np.testing.assert_array_equal(
+                ht.repeat(a, 2, axis=1).numpy(), np.repeat(a_np, 2, axis=1)
+            )
+            np.testing.assert_array_equal(ht.repeat(a, 2, axis=0).numpy(), np.repeat(a_np, 2, axis=0))
+
+    def test_array_repeats(self):
+        a_np = np.arange(4, dtype=np.int64)
+        out = ht.repeat(ht.array(a_np, split=0), [1, 0, 2, 3])
+        np.testing.assert_array_equal(out.numpy(), np.repeat(a_np, [1, 0, 2, 3]))
+
+
+class TestUniqueDepth(TestCase):
+    def test_duplicates_across_shards(self):
+        p = self.get_size()
+        a_np = np.tile(np.array([3, 1, 2], dtype=np.int64), 2 * p + 1)
+        res = ht.unique(ht.array(a_np, split=0), sorted=True)
+        np.testing.assert_array_equal(np.sort(res.numpy()), np.unique(a_np))
+
+    def test_return_inverse(self):
+        a_np = np.array([1, 3, 1, 2, 3], dtype=np.int64)
+        res, inv = ht.unique(ht.array(a_np, split=0), return_inverse=True)
+        np.testing.assert_array_equal(res.numpy()[inv.numpy()], a_np)
+
+    def test_axis0(self):
+        a_np = np.array([[1, 2], [3, 4], [1, 2]], dtype=np.int64)
+        res = ht.unique(ht.array(a_np, split=0), axis=0)
+        np.testing.assert_array_equal(np.sort(res.numpy(), axis=0), np.unique(a_np, axis=0))
+
+
+class TestGetSetItemDepth(TestCase):
+    def _arrs(self):
+        p = self.get_size()
+        a_np = np.arange((3 * p + 1) * 4, dtype=np.float64).reshape(3 * p + 1, 4)
+        return a_np, ht.array(a_np, split=0)
+
+    def test_negative_and_step_slices(self):
+        a_np, a = self._arrs()
+        for key in [
+            slice(None, None, 2),
+            slice(-3, None),
+            slice(None, -2),
+            slice(-1, None, -1),
+            (slice(1, -1), slice(None, None, 2)),
+            (-1, slice(None)),
+            (slice(None), -2),
+        ]:
+            np.testing.assert_array_equal(a[key].numpy(), a_np[key], err_msg=str(key))
+
+    def test_newaxis_and_ellipsis(self):
+        a_np, a = self._arrs()
+        np.testing.assert_array_equal(a[None].numpy(), a_np[None])
+        np.testing.assert_array_equal(a[..., 0].numpy(), a_np[..., 0])
+        np.testing.assert_array_equal(a[0, ...].numpy(), a_np[0, ...])
+
+    def test_boolean_mask_assignment(self):
+        a_np, a = self._arrs()
+        mask = a_np[:, 0] > a_np[:, 0].mean()
+        a[ht.array(mask, split=0)] = -1.0
+        a_np[mask] = -1.0
+        np.testing.assert_array_equal(a.numpy(), a_np)
+
+    def test_scalar_broadcast_assignment(self):
+        a_np, a = self._arrs()
+        a[2:5] = 9.5
+        a_np[2:5] = 9.5
+        np.testing.assert_array_equal(a.numpy(), a_np)
+
+    def test_fancy_plus_slice(self):
+        a_np, a = self._arrs()
+        idx = np.array([0, 2, 1])
+        np.testing.assert_array_equal(a[idx, 1:3].numpy(), a_np[idx, 1:3])
+
+    def test_setitem_row_with_vector(self):
+        a_np, a = self._arrs()
+        a[1] = np.arange(4.0)
+        a_np[1] = np.arange(4.0)
+        np.testing.assert_array_equal(a.numpy(), a_np)
+
+    def test_setitem_dtype_cast(self):
+        a = ht.arange(6, dtype=ht.int32, split=0)
+        a[0] = 2.9  # numpy semantics: cast toward the destination dtype
+        self.assertEqual(a.dtype, ht.int32)
+        self.assertEqual(int(a[0].item()), 2)
+
+
+class TestReshapeDepth(TestCase):
+    def test_new_split(self):
+        p = self.get_size()
+        a_np = np.arange(4 * p * 6, dtype=np.float64).reshape(4 * p, 6)
+        a = ht.array(a_np, split=0)
+        out = ht.reshape(a, (6, 4 * p), new_split=1)
+        self.assertEqual(out.split, 1)
+        np.testing.assert_array_equal(out.numpy(), a_np.reshape(6, 4 * p))
+
+    def test_minus_one_inference(self):
+        a = ht.arange(24, split=0)
+        out = ht.reshape(a, (-1, 6))
+        self.assertEqual(out.shape, (4, 6))
+
+    def test_ragged_reshape(self):
+        p = self.get_size()
+        n = 2 * p + 1
+        a = ht.arange(n * 3, split=0)
+        out = ht.reshape(a, (n, 3))
+        np.testing.assert_array_equal(out.numpy(), np.arange(n * 3).reshape(n, 3))
+
+
+class TestCommHelpersNonContiguous(TestCase):
+    """Collective helpers over transposed / strided views (the reference's
+    derived-datatype cases, communication.py:276-292)."""
+
+    def setUp(self):
+        if self.get_size() == 1:
+            self.skipTest("collectives need a distributed mesh")
+
+    def test_allgather_transposed(self):
+        import jax.numpy as jnp
+
+        p = self.get_size()
+        comm = self.comm
+        base = np.arange(p * 3, dtype=np.float64).reshape(p, 3)
+        x = jnp.asarray(base).T  # (3, p) non-contiguous view, split col-wise
+
+        def kernel(xs):
+            return comm.allgather(xs, gather_axis=1, tiled=True)
+
+        out = comm.apply(kernel, x, in_splits=[1], out_splits=None)
+        np.testing.assert_array_equal(np.asarray(out), base.T)
+
+    def test_alltoall_transposed(self):
+        import jax.numpy as jnp
+
+        p = self.get_size()
+        comm = self.comm
+        base = np.arange(p * p, dtype=np.float64).reshape(p, p)
+        x = jnp.asarray(base).T
+
+        def kernel(xs):
+            return comm.alltoall(xs, split_axis=0, concat_axis=1)
+
+        out = comm.apply(kernel, x, in_splits=[1], out_splits=0)
+        # alltoall of the transpose is the transpose blocked the other way
+        self.assertEqual(tuple(out.shape), (p, p))
+
+    def test_exscan_callable_op_on_tuples(self):
+        import jax.numpy as jnp
+
+        p = self.get_size()
+        comm = self.comm
+        x = jnp.arange(p, dtype=jnp.float64)
+
+        def combine(a, b):
+            return (a[0] + b[0], jnp.maximum(a[1], b[1]))
+
+        def kernel(xs):
+            s, m = comm.exscan(
+                (xs, xs), op=combine, neutral=(jnp.zeros_like(xs), jnp.full_like(xs, -np.inf))
+            )
+            return s + 0 * jnp.where(jnp.isfinite(m), m, 0.0)
+
+        out = comm.apply(kernel, x, in_splits=[0], out_splits=0)
+        expect = np.concatenate([[0], np.cumsum(np.arange(p))[:-1]])
+        np.testing.assert_array_equal(np.asarray(out), expect)
+
+
+class TestMiscEdgeSweeps(TestCase):
+    def test_diff_roll_ragged(self):
+        n = 3 * self.get_size() + 2
+        a_np = np.cumsum(np.arange(n, dtype=np.float64))
+        a = ht.array(a_np, split=0)
+        np.testing.assert_array_equal(ht.diff(a).numpy(), np.diff(a_np))
+        np.testing.assert_array_equal(ht.roll(a, -3).numpy(), np.roll(a_np, -3))
+
+    def test_squeeze_swap_move(self):
+        a_np = np.arange(12, dtype=np.float64).reshape(3, 1, 4)
+        for split in (None, 0, 2):
+            a = ht.array(a_np, split=split)
+            np.testing.assert_array_equal(ht.squeeze(a, 1).numpy(), a_np.squeeze(1))
+            np.testing.assert_array_equal(ht.swapaxes(a, 0, 2).numpy(), a_np.swapaxes(0, 2))
+            np.testing.assert_array_equal(
+                ht.moveaxis(a, 0, -1).numpy(), np.moveaxis(a_np, 0, -1)
+            )
+
+    def test_split_functions(self):
+        p = self.get_size()
+        a_np = np.arange(4 * p * 2, dtype=np.float64).reshape(4 * p, 2)
+        a = ht.array(a_np, split=0)
+        parts = ht.split(a, 4)
+        self.assertEqual(len(parts), 4)
+        for got, exp in zip(parts, np.split(a_np, 4)):
+            np.testing.assert_array_equal(got.numpy(), exp)
+
+    def test_tile_ragged(self):
+        n = self.get_size() + 1
+        a_np = np.arange(n, dtype=np.int64)
+        np.testing.assert_array_equal(ht.tile(ht.array(a_np, split=0), 3).numpy(), np.tile(a_np, 3))
+
+    def test_sort_descending_2d(self):
+        p = self.get_size()
+        rng = np.random.default_rng(0)
+        a_np = rng.standard_normal((2 * p + 1, 5))
+        for split in (None, 0, 1):
+            v, i = ht.sort(ht.array(a_np, split=split), axis=0, descending=True)
+            np.testing.assert_allclose(v.numpy(), -np.sort(-a_np, axis=0), atol=1e-12)

@@ -162,8 +162,10 @@ class TestRearrange(TestCase):
             np.testing.assert_array_equal(
                 ht.repeat(x, 2, axis=1).numpy(), np.repeat(a, 2, axis=1)
             )
-        with pytest.raises(NotImplementedError):
-            ht.pad(ht.array(a), ((1, 1), (1, 1)), mode="edge")
+        np.testing.assert_array_equal(
+            ht.pad(ht.array(a), ((1, 1), (1, 1)), mode="edge").numpy(),
+            np.pad(a, ((1, 1), (1, 1)), mode="edge"),
+        )
 
     def test_broadcast(self):
         a = np.arange(3.0, dtype=np.float32)

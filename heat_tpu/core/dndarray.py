@@ -263,7 +263,10 @@ class DNDarray:
 
     @property
     def lshape(self) -> Tuple[int, ...]:
-        """Shape of this process's first device shard (reference dndarray.py:301)."""
+        """Logical shape of the mesh's rank-0 device shard (reference
+        dndarray.py:301 reports the calling rank's local tensor; under a
+        single controller this is the representative chunk — see
+        doc/internals_distribution.md for the multi-host caveats)."""
         _, lshape, _ = self.__comm.chunk(self.__gshape, self.__split, rank=0)
         return lshape
 

@@ -217,10 +217,57 @@ class DataParallel:
 
 
 class DataParallelMultiGPU(DataParallel):
-    """Node-local data parallelism (reference data_parallel.py:314-376 wraps
-    torch-DDP for DASO). Here the intra-host axis is simply a sub-mesh of the
-    same device mesh; DASO composes two of these axes itself, so this class
-    only exists for API parity."""
+    """Node-local data parallelism bound to a DASO optimizer (reference
+    data_parallel.py:314-376: wraps the model in torch-DDP over the node's
+    GPUs and hands the gradient stream to DASO).
 
-    def __init__(self, module, optimizer=None, comm=None, **kwargs):
+    The TPU rendering: construct with a :class:`~heat_tpu.optim.DASO`
+    instance and this wrapper attaches the module to it (``daso.add_model``)
+    — ``step``/``forward``/checkpointing then delegate to DASO's 2-axis
+    (dcn x ici) schedule, which owns the intra-node sync cadence the
+    reference's DDP wrapper provided. Without a DASO it degrades to plain
+    :class:`DataParallel` over the full mesh (the reference class likewise
+    requires its optimizer to be useful).
+    """
+
+    def __init__(self, module, optimizer=None, comm=None, rng_seed: int = 0,
+                 sample_input=None, **kwargs):
+        from ..optim.dp_optimizer import DASO
+
+        self.daso: Optional["DASO"] = None
+        if isinstance(optimizer, DASO):
+            if sample_input is None:
+                raise ValueError(
+                    "binding DataParallelMultiGPU to a DASO requires sample_input "
+                    "(the reference's DDP wrapper likewise needs a model pass "
+                    "to register its gradient hooks)"
+                )
+            self.daso = optimizer
+            self.module = module
+            self.comm = optimizer.comm
+            optimizer.add_model(module, rng_seed, sample_input)
+            return
         super().__init__(module, comm=comm, optimizer=optimizer, **kwargs)
+
+    def step(self, x, y):
+        if self.daso is not None:
+            return self.daso.step(x, y)
+        return super().step(x, y)
+
+    def forward(self, x):
+        if self.daso is not None:
+            return self.daso.forward(x)
+        return super().forward(x)
+
+    __call__ = forward
+
+    def save(self, directory: str, step: int = 0, keep: int = 3) -> str:
+        if self.daso is not None:
+            return self.daso.save(directory, step=step, keep=keep)
+        return super().save(directory, step=step, keep=keep)
+
+    def restore(self, directory: str, step: Optional[int] = None):
+        if self.daso is not None:
+            self.daso.restore(directory, step=step)
+            return self
+        return super().restore(directory, step=step)

@@ -141,8 +141,11 @@ class TestAdvancedSetitemSplit(TestCase):
         exp[exp > 20] = 0.0
         np.testing.assert_allclose(_np(x), exp)
         self.assertEqual(x.split, 0)
-        spec = x.larray.sharding.spec
-        self.assertTrue(len(spec) > 0 and spec[0] == self.comm.axis_name)
+        # at mesh 1 JAX may report a SingleDeviceSharding (no spec); the
+        # meaningful assertion is equivalence with the split-0 layout
+        self.assertTrue(
+            x.larray.sharding.is_equivalent_to(self.comm.sharding(x.ndim, 0), x.ndim)
+        )
 
     def test_integer_array_setitem(self):
         x_np = np.arange(32, dtype=np.float64).reshape(16, 2)

@@ -148,6 +148,35 @@ class TestDASO(TestCase):
         with pytest.raises(ValueError):
             ht.optim.DetectMetricPlateau(threshold_mode="diagonal")
 
+    def test_data_parallel_multigpu_binds_daso(self):
+        # reference data_parallel.py:314-376: the MultiGPU wrapper exists to
+        # hand the model's gradient stream to DASO; here binding delegates
+        # step/forward/checkpointing to the DASO schedule
+        p = self.get_size()
+        if p < 2 or p % 2:
+            self.skipTest("needs an even distributed mesh")
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((8 * p, 6)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int32)
+        daso = ht.optim.DASO(
+            ht.optim.SGD(0.05), total_epochs=2, warmup_epochs=1, cooldown_epochs=1, nodes=2
+        )
+        model = ht.nn.DataParallelMultiGPU(
+            ht.nn.MLP(features=(8, 2)), optimizer=daso, sample_input=X[:p]
+        )
+        self.assertIs(model.daso, daso)
+        loss = model.step(X[: 2 * p], y[: 2 * p])
+        self.assertTrue(np.isfinite(loss))
+        logits = model(X[: 2 * p])
+        self.assertEqual(logits.shape, (2 * p, 2))
+        # without a DASO it degrades to plain DataParallel
+        plain = ht.nn.DataParallelMultiGPU(
+            ht.nn.MLP(features=(8, 2)), optimizer=ht.optim.SGD(0.05)
+        )
+        self.assertIsNone(plain.daso)
+        with pytest.raises(ValueError):
+            ht.nn.DataParallelMultiGPU(ht.nn.MLP(features=(8, 2)), optimizer=daso)
+
     def test_dp_optimizer_wrapper(self):
         import jax.numpy as jnp
 

@@ -196,10 +196,30 @@ def det(a: DNDarray) -> DNDarray:
 
 def inv(a: DNDarray) -> DNDarray:
     """Matrix inverse (reference basics.py:312-421: distributed Gauss-Jordan
-    with pivoting; here XLA's LU solve over the sharded operand)."""
+    with pivoting).
+
+    Distributed 2-D operands invert via the framework's own distributed
+    factorizations: ``A = QR`` (TSQR / blocked panel loop, linalg/qr.py) and
+    ``A^-1 = R^-1 Q^T`` through the SquareDiagTiles-blocked triangular solve
+    — numerically stable without the pivoting choreography the reference's
+    Gauss-Jordan needs. Small/replicated/batched operands take one XLA LU
+    kernel.
+    """
     sanitation.sanitize_in(a)
     if a.ndim < 2 or a.shape[-1] != a.shape[-2]:
         raise ValueError("Last two dimensions of the array must be square")
+    if a.ndim == 2 and a.split is not None and a.comm.size > 1:
+        from .qr import qr as _qr
+        from .solver import solve_triangular
+
+        af = a if types.heat_type_is_inexact(a.dtype) else a.astype(
+            types.promote_types(a.dtype, types.float32)
+        )
+        Q, R = _qr(af)
+        qt = transpose(Q, (1, 0))
+        out = solve_triangular(R, qt, lower=False)
+        out.resplit_(a.split)
+        return out
     result = jnp.linalg.inv(a.larray.astype(_float_for(a)))
     return _wrap_like(result, a.split, a)
 

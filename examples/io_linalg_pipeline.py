@@ -1,0 +1,53 @@
+"""End-to-end data pipeline: sharded HDF5 I/O -> distributed linear algebra.
+
+Demonstrates the round-3 subsystems working together (the reference's
+analogous flow is load_hdf5 -> qr / solvers across MPI ranks):
+
+1. write a feature matrix to HDF5, streamed shard by shard;
+2. load it back with per-device hyperslab reads (split=0);
+3. orthogonalize with distributed TSQR;
+4. solve a least-squares problem via R x = Q^T b with the
+   SquareDiagTiles-blocked triangular solve;
+5. smooth a signal with the halo-exchange convolution.
+
+Run:  XLA_FLAGS=--xla_force_host_platform_device_count=8 python examples/io_linalg_pipeline.py
+"""
+
+import os
+import tempfile
+
+import numpy as np
+
+import heat_tpu as ht
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    p = ht.get_comm().size
+    m, n = 64 * max(p, 1), 12
+
+    # ground-truth least-squares problem
+    A_np = rng.standard_normal((m, n))
+    coef = rng.standard_normal(n)
+    b_np = A_np @ coef + 0.01 * rng.standard_normal(m)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "features.h5")
+        ht.save_hdf5(ht.array(A_np, split=0), path, "A")  # streamed per shard
+        A = ht.load_hdf5(path, "A", dtype=ht.float64, split=0)  # per-device hyperslabs
+
+    Q, R = ht.linalg.qr(A)  # TSQR: one R-tile all-gather
+    qt_b = Q.T @ ht.array(b_np, split=0)
+    x = ht.linalg.solve_triangular(R, qt_b)  # blocked substitution
+    rel_err = float(np.linalg.norm(x.numpy() - coef) / np.linalg.norm(coef))
+    print(f"least-squares relative error: {rel_err:.2e}")
+
+    # halo-exchange smoothing of a noisy signal
+    noisy = ht.array(np.sin(np.linspace(0, 6, 16 * p)) + 0.1 * rng.standard_normal(16 * p), split=0)
+    kernel = ht.array(np.ones(5) / 5.0)
+    smooth = ht.convolve(noisy, kernel, mode="same")  # ppermute halos + local conv
+    print(f"smoothed variance: {float(smooth.var().item()):.4f}")
+
+
+if __name__ == "__main__":
+    main()

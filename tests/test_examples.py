@@ -9,7 +9,9 @@ import numpy as np
 import pytest
 
 
-@pytest.mark.parametrize("script", ["knn_demo", "lasso_demo", "cluster_demo"])
+@pytest.mark.parametrize(
+    "script", ["knn_demo", "lasso_demo", "cluster_demo", "io_linalg_pipeline"]
+)
 def test_example_runs(script, capsys):
     runpy.run_path(f"examples/{script}.py", run_name="__main__")
     out = capsys.readouterr().out
@@ -20,3 +22,6 @@ def test_example_runs(script, capsys):
         assert acc > 0.9
     if script == "lasso_demo":
         assert "lambda" in out
+    if script == "io_linalg_pipeline":
+        err = float(out.splitlines()[0].rsplit(" ", 1)[-1])
+        assert err < 1e-2

@@ -23,6 +23,32 @@ from ..core.dndarray import DNDarray, _ensure_split
 __all__ = ["_KCluster"]
 
 
+def _kmeanspp_fixed(key: jax.Array, data: jax.Array, k: int, metric) -> jax.Array:
+    """Fixed-shape kmeans++ over one in-memory block, traceable under jit:
+    the centers buffer is (k, f) with unfilled rows masked out of the
+    min-distance via the step index (no data-dependent shapes, so the whole
+    sampling loop is one fori_loop on device)."""
+    n, f = data.shape
+    key, sub0 = jax.random.split(key)
+    first = jax.random.randint(sub0, (), 0, n)
+    centers0 = jnp.zeros((k, f), data.dtype).at[0].set(data[first])
+
+    def body(i, carry):
+        centers, key = carry
+        key, sub = jax.random.split(key)
+        d = metric(data, centers)  # (n, k)
+        valid = jnp.arange(k)[None, :] < i
+        dmin = jnp.min(jnp.where(valid, d, jnp.inf), axis=1)
+        total = jnp.sum(dmin)
+        prob = jnp.where(total > 0, dmin / jnp.maximum(total, 1e-30), 1.0 / n)
+        r = jax.random.uniform(sub, dtype=prob.dtype)
+        nxt = jnp.clip(jnp.searchsorted(jnp.cumsum(prob), r), 0, n - 1)
+        return centers.at[i].set(data[nxt]), key
+
+    centers, _ = jax.lax.fori_loop(1, k, body, (centers0, key))
+    return centers
+
+
 class _KCluster(ClusteringMixin, BaseEstimator):
     """Base class for k-statistics clustering (reference _kcluster.py:13-86).
 
@@ -94,6 +120,14 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         if self.init == "random":
             idx = ht_random.randint(0, n, (k,)).larray
             return data[idx]
+        if (
+            self.init == "batchparallel"
+            and x.split == 0
+            and x.comm.size > 1
+            and not x.padded
+            and n // x.comm.size >= k
+        ):
+            return self._batchparallel_init(x, data, k)
         # kmeans++ / probability_based (reference _kcluster.py:142-187)
         idx0 = int(ht_random.randint(0, n, (1,)).larray[0])
         centers = data[idx0][None, :]
@@ -107,6 +141,27 @@ class _KCluster(ClusteringMixin, BaseEstimator):
             nxt = min(nxt, n - 1)
             centers = jnp.concatenate([centers, data[nxt][None, :]], axis=0)
         return centers
+
+    def _batchparallel_init(self, x: DNDarray, data: jax.Array, k: int) -> jax.Array:
+        """Scalable batch-parallel init: every device runs a fixed-shape
+        kmeans++ over its OWN block (zero communication), the p*k candidate
+        centroids are gathered once, and one more kmeans++ over the
+        candidates picks the k finals — one (p*k, f) all-gather is the entire
+        communication budget, vs the per-step sampling sync of plain
+        kmeans++. The whole init is one XLA program."""
+        comm = x.comm
+        metric = self._metric
+        seed = int(ht_random.randint(0, 2**31 - 1, (1,)).larray[0])
+        base_key = jax.random.PRNGKey(seed)
+        axis = comm.axis_name
+
+        def kernel(block):
+            idx = jax.lax.axis_index(axis)
+            local = _kmeanspp_fixed(jax.random.fold_in(base_key, idx), block, k, metric)
+            cands = jax.lax.all_gather(local, axis, tiled=True)  # (p*k, f)
+            return _kmeanspp_fixed(base_key, cands, k, metric)
+
+        return comm.apply(kernel, data, in_splits=[0], out_splits=None)
 
     def _assign_to_cluster(self, x: DNDarray):
         """Cluster id per sample (reference _kcluster.py:196-209)."""

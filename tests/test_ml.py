@@ -271,3 +271,25 @@ class TestRegression(TestCase):
             lasso.fit(X, yv)
         with pytest.raises(RuntimeError):
             ht.regression.Lasso().predict(ht.array(X))
+
+
+class TestBatchParallelInit(TestCase):
+    def test_batchparallel_recovers_blobs(self):
+        # scalable init: per-device kmeans++ + one (p*k, f) candidate gather
+        p = self.get_size()
+        rng = np.random.default_rng(0)
+        blobs = np.concatenate(
+            [rng.standard_normal((40 * max(p, 2), 4)) + c * 8 for c in range(4)]
+        )
+        rng.shuffle(blobs)
+        x = ht.array(blobs, split=0)
+        km = ht.cluster.KMeans(n_clusters=4, init="batchparallel", max_iter=50).fit(x)
+        centers = np.sort(km.cluster_centers_.numpy()[:, 0])
+        np.testing.assert_allclose(centers, [0, 8, 16, 24], atol=1.5)
+
+    def test_batchparallel_falls_back_single_device(self):
+        # ragged or single-device inputs quietly use the kmeans++ path
+        rng = np.random.default_rng(1)
+        x = ht.array(rng.standard_normal((4 * self.get_size() + 1, 3)), split=0)
+        km = ht.cluster.KMeans(n_clusters=2, init="batchparallel", max_iter=10).fit(x)
+        self.assertEqual(km.cluster_centers_.shape, (2, 3))

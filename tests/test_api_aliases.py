@@ -85,3 +85,24 @@ class TestManipulationWrappers(TestCase):
         r = ht.redistribute(x)
         np.testing.assert_array_equal(r.numpy(), np.arange(10))
         self.assertEqual(b.split, 0)
+
+
+class TestFullAPIParity(TestCase):
+    def test_every_reference_public_name_reachable(self):
+        """Every name in the reference's __all__ lists exists here (same
+        top-level or submodule location) — the component-inventory contract,
+        machine-checked."""
+        import os
+
+        ref = "/root/reference/heat"
+        if not os.path.isdir(ref):
+            self.skipTest("reference checkout not present")
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+        try:
+            from api_parity_check import missing_names
+        finally:
+            sys.path.pop(0)
+        miss = missing_names(ref)
+        self.assertEqual(miss, [], f"missing reference API names: {miss}")

@@ -198,3 +198,48 @@ class TestPrintingDepth(TestCase):
         with contextlib.redirect_stdout(buf):
             ht.print0("hello")
         self.assertIn("hello", buf.getvalue())
+
+
+class TestDistributedPercentile(TestCase):
+    """The gather-free bisection quantile kernel (reference
+    statistics.py:1406-1675 bin-count protocol)."""
+
+    def test_all_methods_match_numpy(self):
+        rng = np.random.default_rng(9)
+        n = 125 * self.get_size()
+        a_np = rng.standard_normal(n) * 50
+        a = ht.array(a_np, split=0)
+        for q in (0, 12.5, 50, 99, 100, [10, 90]):
+            for method in ("linear", "lower", "higher", "midpoint", "nearest"):
+                np.testing.assert_allclose(
+                    np.asarray(ht.percentile(a, q, interpolation=method).numpy()),
+                    np.percentile(a_np, q, method=method),
+                    atol=1e-9,
+                    err_msg=f"q={q} method={method}",
+                )
+
+    def test_duplicates(self):
+        t_np = np.repeat(np.arange(8.0), 5 * self.get_size())
+        t = ht.array(t_np, split=0)
+        np.testing.assert_allclose(ht.percentile(t, 50).numpy(), np.percentile(t_np, 50))
+
+    def test_bisect_kernel_is_gather_free(self):
+        if self.get_size() == 1:
+            self.skipTest("needs a distributed mesh")
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu.core.statistics import _order_stats_bisect
+
+        comm = self.comm
+        f = jax.jit(_order_stats_bisect, in_shardings=(comm.sharding(1, 0), None))
+        hlo = (
+            f.lower(
+                jax.ShapeDtypeStruct((100 * comm.size,), jnp.float64),
+                jax.ShapeDtypeStruct((4,), jnp.int64),
+            )
+            .compile()
+            .as_text()
+        )
+        self.assertNotIn("all-gather", hlo)
+        self.assertIn("all-reduce", hlo)

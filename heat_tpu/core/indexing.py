@@ -50,15 +50,17 @@ def where(cond: DNDarray, x=None, y=None) -> DNDarray:
     x_ref = x if isinstance(x, DNDarray) else y
 
     def op(a, b):
-        # the engine's pad-aware fast path hands us PHYSICAL (padded) payloads;
-        # align cond to the same layout (garbage selected in the padding
-        # region stays in the padding region)
+        # the engine's pad-aware fast path hands us PHYSICAL (padded) payloads
+        # (in either operand slot — the other may be a scalar); align cond to
+        # the same layout (garbage selected in the padding region stays in
+        # the padding region)
         c = cond.larray
         a_sh = tuple(getattr(a, "shape", ()))
+        b_sh = tuple(getattr(b, "shape", ()))
         if (
             isinstance(x_ref, DNDarray)
             and x_ref.padded
-            and a_sh == tuple(x_ref.parray.shape)
+            and tuple(x_ref.parray.shape) in (a_sh, b_sh)
             and cond.ndim == x_ref.ndim
             and cond.shape[x_ref.split] == x_ref.shape[x_ref.split]
         ):

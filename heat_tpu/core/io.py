@@ -360,21 +360,25 @@ def load_csv(
         # trailing lines produce zero-width ranges that parse to no rows
         with open(path, "rb") as f:
             with mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ) as mm:
-                # determine column count from the first non-empty data line
-                ncols = None
-                for i in range(len(offs) - 1):
-                    line = bytes(mm[offs[i]:offs[i + 1]]).strip()
-                    if line:
-                        ncols = line.count(sep.encode()) + 1
-                        break
-                if ncols is None:
+                # non-empty data lines from offset arithmetic alone (no
+                # payload copies): a content line is longer than its line
+                # terminator. CRLF files have 2-byte terminators.
+                lengths = np.diff(offs)
+                crlf = bool(len(offs) > 1 and offs[1] >= 2 and mm[offs[1] - 2 : offs[1]] == b"\r\n")
+                term = 2 if crlf else 1
+                rows = np.flatnonzero(lengths > term).tolist()
+                # the (unterminated) final line has no newline to discount
+                if lengths.size and lengths[-1] in (1, 2) and (len(offs) - 2) not in rows:
+                    if bytes(mm[offs[-2]:offs[-1]]).strip():
+                        rows.append(len(offs) - 2)
+                if not rows:
                     return factories.array(
                         np.empty((0, 0), dtype=npdtype), dtype=dtype, split=0,
                         device=device, comm=comm,
                     )
-                # row index of each non-empty line
-                rows = [i for i in range(len(offs) - 1)
-                        if bytes(mm[offs[i]:offs[i + 1]]).strip()]
+                # column count from the first data line only
+                first = bytes(mm[offs[rows[0]]:offs[rows[0] + 1]]).strip()
+                ncols = first.count(sep.encode()) + 1
                 gshape = (len(rows), ncols)
 
                 def read_block(sl):

@@ -70,7 +70,10 @@ def qr(
 
     q_split = a.split
     r_split: Optional[int] = None
-    if a.split == 0 and p > 1 and m >= n and m >= p:
+    # TSQR needs a full (n, n) R per block: block = ceil(m/p) >= n, otherwise
+    # the R-tile all-gather would move p*block*n = the FULL operand volume —
+    # exactly the silent gather the explicit fallback policy exists to avoid
+    if a.split == 0 and p > 1 and m >= n and -(-m // p) >= n:
         q_arr, r_arr = _tsqr(a, comm)
     elif a.split == 1 and p > 1 and m >= n:
         q_arr, r_arr = _panel_qr_split1(a, comm)
@@ -81,8 +84,9 @@ def qr(
         if a.is_distributed() and a.size > _REPLICATED_MAX_ELEMENTS:
             warnings.warn(
                 f"qr falls back to a replicated kernel for shape {a.shape} "
-                f"split={a.split} (short-wide row-split has no distributed "
-                "schedule); consider resplit or a transpose formulation",
+                f"split={a.split} (no gather-free distributed schedule for "
+                "this shape: short-wide, or row blocks narrower than n); "
+                "consider resplit or a transpose formulation",
                 stacklevel=2,
             )
         q_arr, r_arr = jnp.linalg.qr(a.larray, mode="reduced")

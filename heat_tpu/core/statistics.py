@@ -468,8 +468,9 @@ def percentile(
         n = x.size
         flat = data.reshape(-1)
         pos = qa / 100.0 * (n - 1)
-        lower = jnp.floor(pos).astype(jnp.int64)
-        upper = jnp.ceil(pos).astype(jnp.int64)
+        idt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+        lower = jnp.floor(pos).astype(idt)
+        upper = jnp.ceil(pos).astype(idt)
         ranks = jnp.concatenate([jnp.atleast_1d(lower).ravel(), jnp.atleast_1d(upper).ravel()])
         stats = _order_stats_bisect(flat, ranks)
         m = ranks.shape[0] // 2

@@ -245,26 +245,31 @@ class DASO:
                         return loss_fn(module.apply(pp, xb), yb), None
 
                     (loss, new_s), grads = jax.value_and_grad(loss_of, has_aux=True)(p)
+                    expand = lambda t: jax.tree.map(lambda a: a[None], t)
                     if sync_ici:
                         # ICI gradient sync (the torch-DDP allreduce)
                         grads = jax.lax.pmean(grads, "ici")
-                    loss = jax.lax.pmean(loss, ("dcn", "ici"))
+                        loss_out = jax.lax.pmean(loss, ("dcn", "ici"))
+                    else:
+                        # solo batch: ZERO collectives — the per-device loss
+                        # ships out sharded and is averaged on the host
+                        loss_out = loss[None]
                     updates, o = opt.update(grads, o, p)
                     p = optax.apply_updates(p, updates)
-                    expand = lambda t: jax.tree.map(lambda a: a[None], t)
                     if stateful:
                         new_s = expand(
                             jax.lax.pmean(new_s, "ici") if sync_ici else new_s
                         )
                     else:
                         new_s = s
-                    return expand(p), new_s, expand(o), loss
+                    return expand(p), new_s, expand(o), loss_out
 
+                loss_spec = P() if sync_ici else P(("dcn", "ici"))
                 return jax.shard_map(
                     kernel,
                     mesh=mesh,
                     in_specs=(group_spec, group_spec, group_spec, batch_spec, batch_spec),
-                    out_specs=(group_spec, group_spec, group_spec, P()),
+                    out_specs=(group_spec, group_spec, group_spec, loss_spec),
                     check_vma=False,
                 )(params, state, opt_state, x, y)
 
@@ -350,7 +355,9 @@ class DASO:
         if gs == 0 or self.current_batch % (gs + 1) == 0:
             waits = float(min(self.batches_to_wait, gs))
             self.params = self._global_merge(self.params, jnp.float32(waits))
-        return float(loss)
+        # solo batches return per-device losses (no in-program collective);
+        # average on the host for a uniform scalar contract
+        return float(jnp.mean(loss))
 
     def _effective_global_skip(self) -> int:
         if self.epoch < self.warmup_epochs:

@@ -148,3 +148,28 @@ class TestQRSchedule(TestCase):
                     budget,
                     f"collective moves more than one panel: {line[:120]}",
                 )
+
+
+class TestQRGuards(TestCase):
+    def test_wide_block_never_silently_gathers(self):
+        # block = ceil(m/p) < n would make the TSQR R-gather move the FULL
+        # operand; such shapes must take the (warned above threshold)
+        # replicated fallback instead
+        p = self.get_size()
+        if p == 1:
+            self.skipTest("needs a distributed mesh")
+        import importlib
+
+        qr_mod = importlib.import_module("heat_tpu.core.linalg.qr")
+        m, n = 2 * p, p + 2  # m >= n but block=2 < n
+        a = ht.array(np.random.default_rng(0).standard_normal((m, n)), split=0)
+        old = qr_mod._REPLICATED_MAX_ELEMENTS
+        qr_mod._REPLICATED_MAX_ELEMENTS = 1
+        try:
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                Q, R = ht.linalg.qr(a)
+            self.assertTrue(any("replicated" in str(x.message) for x in w))
+        finally:
+            qr_mod._REPLICATED_MAX_ELEMENTS = old
+        np.testing.assert_allclose(Q.numpy() @ R.numpy(), a.numpy(), atol=1e-10)

@@ -174,3 +174,16 @@ class TestRaggedDistribution(TestCase):
         self.assertEqual(a.shape, (m,))
         self.assertEqual(a.parray.shape[0], self._block(m) * self.get_size())
         np.testing.assert_array_equal(a.numpy(), np.arange(m))
+
+    def test_where_scalar_either_slot(self):
+        # regression: the engine fast path may hand the physical payload in
+        # either operand slot; cond must align in both
+        n = 2 * self.get_size() + 1
+        a_np = np.arange(n, dtype=np.float64)
+        a = ht.array(a_np, split=0)
+        np.testing.assert_allclose(
+            ht.where(a > 4, 0.0, a).numpy(), np.where(a_np > 4, 0.0, a_np)
+        )
+        np.testing.assert_allclose(
+            ht.where(a > 4, a, 0.0).numpy(), np.where(a_np > 4, a_np, 0.0)
+        )

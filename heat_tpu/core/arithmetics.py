@@ -219,17 +219,39 @@ def cumsum(a, axis: int, dtype=None, out=None) -> DNDarray:
     return _cum_op(jnp.cumsum, a, axis, out=out, dtype=dtype)
 
 
-def diff(a, n: int = 1, axis: int = -1) -> DNDarray:
+def diff(a, n: int = 1, axis: int = -1, prepend=None, append=None) -> DNDarray:
     """n-th discrete difference (reference arithmetics.py:293-429: one-row
     neighbor exchange over MPI; here the shifted subtraction's boundary comms
-    are XLA's)."""
+    are XLA's). ``prepend``/``append`` extend the array along ``axis`` before
+    differencing, numpy-style."""
     if n == 0:
         return a
     if n < 0:
         raise ValueError(f"diff requires that n be a positive number, got {n}")
-    from ._operations import __local_op as local
+    from . import sanitation
+    from .dndarray import _ensure_split
 
-    return _local_op(lambda x: jnp.diff(x, n=n, axis=axis), a, no_cast=True)
+    kw = {}
+    for key, val in (("prepend", prepend), ("append", append)):
+        if val is not None:
+            kw[key] = val.larray if isinstance(val, DNDarray) else jnp.asarray(val)
+
+    # diff is a STENCIL, not an elementwise op: it must never see the padding
+    # of a ragged payload (with prepend/append the result shape can coincide
+    # with the padded shape, defeating the engine's shape heuristic), so it
+    # computes on the logical view explicitly
+    sanitation.sanitize_in(a)
+    result = jnp.diff(a.larray, n=n, axis=axis, **kw)
+    split = a.split if result.ndim == a.ndim else None
+    result = _ensure_split(result, split, a.comm)
+    return DNDarray(
+        result,
+        tuple(result.shape),
+        types.canonical_heat_type(result.dtype),
+        split,
+        a.device,
+        a.comm,
+    )
 
 
 def gcd(t1, t2, out=None, where=None) -> DNDarray:
@@ -271,12 +293,14 @@ def nansum(a, axis=None, out=None, keepdims=False) -> DNDarray:
     return _reduce_op(jnp.nansum, a, axis, out=out, keepdims=keepdims)
 
 
-def prod(a, axis=None, out=None, keepdims=False) -> DNDarray:
-    """Product of elements over axis (reference arithmetics.py:803)."""
-    return _reduce_op(jnp.prod, a, axis, out=out, keepdims=keepdims)
+def prod(a, axis=None, out=None, keepdims=False, keepdim=None) -> DNDarray:
+    """Product of elements over axis (reference arithmetics.py:803).
+    ``keepdim`` is the reference's torch-style alias for ``keepdims``."""
+    return _reduce_op(jnp.prod, a, axis, out=out, keepdims=keepdims if keepdim is None else keepdim)
 
 
-def sum(a, axis=None, out=None, keepdims=False) -> DNDarray:
+def sum(a, axis=None, out=None, keepdims=False, keepdim=None) -> DNDarray:
     """Sum of elements over axis (reference arithmetics.py:946; cross-split
-    reduction is the reference's Allreduce, here an XLA psum)."""
-    return _reduce_op(jnp.sum, a, axis, out=out, keepdims=keepdims)
+    reduction is the reference's Allreduce, here an XLA psum). ``keepdim``
+    is the reference's torch-style alias for ``keepdims``."""
+    return _reduce_op(jnp.sum, a, axis, out=out, keepdims=keepdims if keepdim is None else keepdim)

@@ -189,20 +189,20 @@ def __factory_like(a, dtype, split, factory, device, comm, **kwargs) -> DNDarray
     return factory(shape, dtype=dtype, split=split, device=device, comm=comm, **kwargs)
 
 
-def empty_like(a, dtype=None, split=None, device=None, comm=None) -> DNDarray:
-    return __factory_like(a, dtype, split, empty, device, comm)
+def empty_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    return __factory_like(a, dtype, split, empty, device, comm, order=order)
 
 
-def zeros_like(a, dtype=None, split=None, device=None, comm=None) -> DNDarray:
-    return __factory_like(a, dtype, split, zeros, device, comm)
+def zeros_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    return __factory_like(a, dtype, split, zeros, device, comm, order=order)
 
 
-def ones_like(a, dtype=None, split=None, device=None, comm=None) -> DNDarray:
-    return __factory_like(a, dtype, split, ones, device, comm)
+def ones_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    return __factory_like(a, dtype, split, ones, device, comm, order=order)
 
 
-def full_like(a, fill_value, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
-    return __factory_like(a, dtype, split, full, device, comm, fill_value=fill_value)
+def full_like(a, fill_value, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
+    return __factory_like(a, dtype, split, full, device, comm, fill_value=fill_value, order=order)
 
 
 def arange(*args, dtype=None, split=None, device=None, comm=None) -> DNDarray:
@@ -270,7 +270,7 @@ def logspace(
     return out
 
 
-def eye(shape, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+def eye(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
     """2-D identity-like array (reference factories.py:735)."""
     if isinstance(shape, (int, np.integer)):
         n, m = int(shape), int(shape)

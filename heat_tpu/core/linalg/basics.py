@@ -158,8 +158,13 @@ def vdot(x1: DNDarray, x2: DNDarray) -> DNDarray:
     return _wrap_like(result, None, x1)
 
 
-def vecdot(x1: DNDarray, x2: DNDarray, axis: Optional[int] = None, keepdims: bool = False) -> DNDarray:
-    """Vector dot along an axis (reference basics.py:2301)."""
+def vecdot(
+    x1: DNDarray, x2: DNDarray, axis: Optional[int] = None, keepdims: bool = False, keepdim=None
+) -> DNDarray:
+    """Vector dot along an axis (reference basics.py:2301). ``keepdim`` is the
+    reference's torch-style alias for ``keepdims``."""
+    if keepdim is not None:
+        keepdims = keepdim
     if axis is None:
         axis = -1
     a, b = x1.larray, x2.larray

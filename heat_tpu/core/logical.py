@@ -35,9 +35,10 @@ __all__ = [
 ]
 
 
-def all(x, axis=None, out=None, keepdims=False) -> DNDarray:
-    """True where all elements (over axis) are truthy (reference logical.py:38)."""
-    res = _reduce_op(jnp.all, x, axis, out=out, keepdims=keepdims)
+def all(x, axis=None, out=None, keepdims=False, keepdim=None) -> DNDarray:
+    """True where all elements (over axis) are truthy (reference logical.py:38).
+    ``keepdim`` is the reference's torch-style alias for ``keepdims``."""
+    res = _reduce_op(jnp.all, x, axis, out=out, keepdims=keepdims if keepdim is None else keepdim)
     return res
 
 
@@ -47,9 +48,10 @@ def allclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = F
     return bool(jnp.all(close.larray).item())
 
 
-def any(x, axis=None, out=None, keepdims=False) -> DNDarray:
-    """True where any element (over axis) is truthy (reference logical.py:145)."""
-    return _reduce_op(jnp.any, x, axis, out=out, keepdims=keepdims)
+def any(x, axis=None, out=None, keepdims=False, keepdim=None) -> DNDarray:
+    """True where any element (over axis) is truthy (reference logical.py:145).
+    ``keepdim`` is the reference's torch-style alias for ``keepdims``."""
+    return _reduce_op(jnp.any, x, axis, out=out, keepdims=keepdims if keepdim is None else keepdim)
 
 
 def isclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> DNDarray:

@@ -367,9 +367,10 @@ def _moment_stat(x, axis, order, unbiased, fischer=True):
     return _wrap(jnp.asarray(g), _reduced_split(x, axis), x)
 
 
-def max(x: DNDarray, axis=None, out=None, keepdims=False) -> DNDarray:
-    """Maximum along axis (reference statistics.py:785-901)."""
-    return _reduce_op(jnp.max, x, axis, out=out, keepdims=keepdims)
+def max(x: DNDarray, axis=None, out=None, keepdims=False, keepdim=None) -> DNDarray:
+    """Maximum along axis (reference statistics.py:785-901). ``keepdim`` is
+    the reference's torch-style alias for ``keepdims``."""
+    return _reduce_op(jnp.max, x, axis, out=out, keepdims=keepdims if keepdim is None else keepdim)
 
 
 def maximum(x1: DNDarray, x2: DNDarray, out=None) -> DNDarray:
@@ -390,11 +391,15 @@ def mean(x: DNDarray, axis=None, keepdims: bool = False) -> DNDarray:
     return _wrap(result, _reduced_split(x, axis, keepdims), x)
 
 
-def median(x: DNDarray, axis: Optional[int] = None, keepdims: bool = False) -> DNDarray:
+def median(x: DNDarray, axis: Optional[int] = None, keepdims: bool = False, keepdim=None) -> DNDarray:
     """Median (reference statistics.py:1008-1042, via percentile's distributed
     bin protocol :1406-1675; a sharded sort-based kernel here)."""
+    if keepdim is not None:
+        keepdims = keepdim  # torch-style alias of the reference
     sanitation.sanitize_in(x)
     axis = sanitize_axis(x.shape, axis)
+    if axis is None and x.split is not None and x.is_distributed() and not x.padded:
+        return percentile(x, 50.0, keepdims=keepdims)  # gather-free bisection
     data = x.larray
     if types.heat_type_is_exact(x.dtype):
         data = data.astype(types.promote_types(x.dtype, types.float32).jax_type())
@@ -402,9 +407,10 @@ def median(x: DNDarray, axis: Optional[int] = None, keepdims: bool = False) -> D
     return _wrap(result, _reduced_split(x, axis, keepdims), x)
 
 
-def min(x: DNDarray, axis=None, out=None, keepdims=False) -> DNDarray:
-    """Minimum along axis (reference statistics.py:1114-1230)."""
-    return _reduce_op(jnp.min, x, axis, out=out, keepdims=keepdims)
+def min(x: DNDarray, axis=None, out=None, keepdims=False, keepdim=None) -> DNDarray:
+    """Minimum along axis (reference statistics.py:1114-1230). ``keepdim`` is
+    the reference's torch-style alias for ``keepdims``."""
+    return _reduce_op(jnp.min, x, axis, out=out, keepdims=keepdims if keepdim is None else keepdim)
 
 
 def minimum(x1: DNDarray, x2: DNDarray, out=None) -> DNDarray:
@@ -446,13 +452,17 @@ def percentile(
     out=None,
     interpolation: str = "linear",
     keepdims: bool = False,
+    keepdim=None,
 ) -> DNDarray:
     """q-th percentile (reference statistics.py:1406-1675: Allgather of local
     bin counts + refinement).
 
     Distributed flat percentiles (``axis=None`` over a split array) run the
     gather-free bisection kernel :func:`_order_stats_bisect`; other cases use
-    one XLA quantile kernel over the logical array."""
+    one XLA quantile kernel over the logical array. ``keepdim`` is the
+    reference's torch-style alias for ``keepdims``."""
+    if keepdim is not None:
+        keepdims = keepdim
     sanitation.sanitize_in(x)
     axis = sanitize_axis(x.shape, axis)
     if interpolation not in ("linear", "lower", "higher", "midpoint", "nearest"):

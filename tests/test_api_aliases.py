@@ -106,3 +106,32 @@ class TestFullAPIParity(TestCase):
             sys.path.pop(0)
         miss = missing_names(ref)
         self.assertEqual(miss, [], f"missing reference API names: {miss}")
+
+
+class TestReferenceKwargSpelling(TestCase):
+    def test_torch_style_keepdim_alias(self):
+        # the reference spells the kwarg torch-style (keepdim); both work here
+        a = ht.array(np.arange(24, dtype=np.float64).reshape(8, 3), split=0)
+        self.assertEqual(ht.sum(a, axis=0, keepdim=True).shape, (1, 3))
+        self.assertEqual(ht.prod(a + 1, axis=0, keepdim=True).shape, (1, 3))
+        self.assertEqual(ht.max(a, axis=1, keepdim=True).shape, (8, 1))
+        self.assertEqual(ht.min(a, axis=1, keepdim=True).shape, (8, 1))
+        self.assertEqual(ht.all(a > -1, axis=0, keepdim=True).shape, (1, 3))
+        self.assertEqual(ht.any(a > 5, axis=0, keepdim=True).shape, (1, 3))
+
+    def test_diff_prepend_append(self):
+        v_np = np.arange(9, dtype=np.float64)
+        v = ht.array(v_np, split=0)
+        np.testing.assert_allclose(
+            ht.diff(v, prepend=0.0).numpy(), np.diff(v_np, prepend=0.0)
+        )
+        np.testing.assert_allclose(
+            ht.diff(v, append=np.array([1.0])).numpy(), np.diff(v_np, append=[1.0])
+        )
+
+    def test_like_factories_accept_order(self):
+        a = ht.ones((4, 3), split=0)
+        for fn in (ht.ones_like, ht.zeros_like, ht.empty_like):
+            self.assertEqual(fn(a, order="F").shape, (4, 3))
+        self.assertEqual(ht.full_like(a, 2.0, order="F").shape, (4, 3))
+        self.assertEqual(ht.eye(4, order="F").shape, (4, 4))

@@ -14,6 +14,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -119,6 +121,50 @@ def solve_triangular(A: DNDarray, b: DNDarray, lower: bool = False) -> DNDarray:
     return out
 
 
+@functools.partial(jax.jit, static_argnums=(2,))
+def _lanczos_fused(Al, v0l, m: int, key):
+    """Whole Lanczos run as ONE XLA program (reference solver.py:68-184 does
+    m Python iterations with a host sync per dot/norm; here the loop, the
+    full reorthogonalization, and the breakdown restart all live on device).
+    V is carried row-major (m, n) so each step is one row update; rows >= i
+    are masked out of the reorthogonalization."""
+    n = v0l.shape[0]
+    dtype = v0l.dtype
+    V = jnp.zeros((m, n), dtype)
+    T = jnp.zeros((m, m), dtype)
+    vr = v0l
+    w = Al @ vr
+    alpha = w @ vr
+    w = w - alpha * vr
+    V = V.at[0].set(vr)
+    T = T.at[0, 0].set(alpha)
+    row_ids = jnp.arange(m)
+
+    def body(i, carry):
+        V, T, w, key = carry
+        beta = jnp.linalg.norm(w)
+        key, sub = jax.random.split(key)
+        # breakdown restart: a random vector replaces the collapsed residual
+        # (the subsequent reorthogonalization projects out span(V[:i]))
+        vn = jax.random.normal(sub, (n,), dtype)
+        cand = jnp.where(beta > 1e-10, w / jnp.maximum(beta, 1e-30), vn)
+        # full reorthogonalization against the first i basis rows
+        Vm = jnp.where((row_ids < i)[:, None], V, jnp.zeros_like(V))
+        proj = Vm @ cand  # (m,)
+        vr = cand - Vm.T @ proj
+        nrm = jnp.linalg.norm(vr)
+        vr = jnp.where(nrm > 1e-12, vr / jnp.maximum(nrm, 1e-30), cand)
+        w2 = Al @ vr
+        alpha = w2 @ vr
+        w_next = w2 - alpha * vr - beta * V[i - 1]
+        T = T.at[i - 1, i].set(beta).at[i, i - 1].set(beta).at[i, i].set(alpha)
+        V = V.at[i].set(vr)
+        return V, T, w_next, key
+
+    V, T, _, _ = jax.lax.fori_loop(1, m, body, (V, T, w, key))
+    return V.T, T
+
+
 def lanczos(
     A: DNDarray,
     m: int,
@@ -127,7 +173,8 @@ def lanczos(
     T_out: Optional[DNDarray] = None,
 ):
     """Lanczos tridiagonalization with full reorthogonalization (reference
-    solver.py:68-184)."""
+    solver.py:68-184), fused into a single XLA program (no per-iteration
+    host round-trips)."""
     if not isinstance(A, DNDarray):
         raise TypeError(f"A needs to be of type DNDarray, but was {type(A)}")
     if not isinstance(m, (int,)):
@@ -147,57 +194,12 @@ def lanczos(
         if v0.split != A.split:
             v0 = factories.array(v0, split=A.split, copy=True)
 
-    T = factories.zeros((m, m), dtype=v0.dtype, comm=A.comm)
-    V = factories.zeros((n, m), dtype=v0.dtype, split=A.split, comm=A.comm)
-
-    vr = v0
-    # first iteration
-    w = matmul(A, vr)
-    alpha = float(dot(w, vr))
-    w = w - alpha * vr
-    T[0, 0] = alpha
-    V[:, 0] = vr
-
-    for i in range(1, m):
-        beta = float(norm(w))
-        if abs(beta) < 1e-10:
-            # breakdown: restart with a random orthogonal vector
-            import numpy as _np
-
-            rng = _np.random.default_rng(i)
-            vn = factories.array(
-                rng.standard_normal(n).astype(_np.dtype(v0.dtype.jax_type())),
-                split=A.split,
-                comm=A.comm,
-            )
-            # orthogonalize against V
-            vi_loc = V.larray[:, :i]
-            proj = jnp.einsum("ij,i->j", vi_loc, vn.larray)
-            vn = factories.array(
-                vn.larray - jnp.einsum("ij,j->i", vi_loc, proj), split=A.split, comm=A.comm
-            )
-            vr = vn / norm(vn)
-        else:
-            vr = w / beta
-
-        # full reorthogonalization (reference solver.py:118-135)
-        vi_loc = V.larray[:, :i]
-        proj = jnp.einsum("ij,i->j", vi_loc, vr.larray)
-        vr = factories.array(
-            vr.larray - jnp.einsum("ij,j->i", vi_loc, proj), split=A.split, comm=A.comm
-        )
-        nrm = float(norm(vr))
-        if nrm > 1e-12:
-            vr = vr / nrm
-
-        w = matmul(A, vr)
-        alpha = float(dot(w, vr))
-        w = w - alpha * vr - beta * V[:, i - 1]
-
-        T[i - 1, i] = beta
-        T[i, i - 1] = beta
-        T[i, i] = alpha
-        V[:, i] = vr
+    v_arr, t_arr = _lanczos_fused(
+        A.larray.astype(v0.dtype.jax_type()), v0.larray, m, jax.random.PRNGKey(0)
+    )
+    V = factories.array(v_arr, comm=A.comm, device=A.device)
+    V.resplit_(A.split)
+    T = factories.array(t_arr, comm=A.comm, device=A.device)
 
     if V_out is not None:
         V_out._replace(V.larray, V.split)

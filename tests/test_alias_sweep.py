@@ -1,0 +1,121 @@
+"""Sweep of the long-tail public surface: math aliases, dtype aliases,
+estimator predicates, sanitation utilities, printing options — every public
+name the deeper suites don't already exercise (reference exposes the same
+tails through heat/core/__init__.py)."""
+
+import numpy as np
+
+import heat_tpu as ht
+
+from harness import TestCase
+
+
+class TestMathAliases(TestCase):
+    def test_trig_aliases(self):
+        v = np.array([0.1, 0.4, 0.8])
+        a = ht.array(v, split=0)
+        np.testing.assert_allclose(ht.acos(a).numpy(), np.arccos(v), atol=1e-12)
+        np.testing.assert_allclose(ht.asin(a).numpy(), np.arcsin(v), atol=1e-12)
+        np.testing.assert_allclose(ht.atan(a).numpy(), np.arctan(v), atol=1e-12)
+        np.testing.assert_allclose(ht.acosh(1 + a).numpy(), np.arccosh(1 + v), atol=1e-12)
+        np.testing.assert_allclose(ht.asinh(a).numpy(), np.arcsinh(v), atol=1e-12)
+        np.testing.assert_allclose(ht.atanh(a).numpy(), np.arctanh(v), atol=1e-12)
+        b = ht.array(v[::-1].copy(), split=0)
+        np.testing.assert_allclose(ht.atan2(a, b).numpy(), np.arctan2(v, v[::-1]), atol=1e-12)
+
+    def test_degrees_radians(self):
+        d = np.array([0.0, 90.0, 180.0])
+        np.testing.assert_allclose(ht.radians(ht.array(d)).numpy(), np.radians(d), atol=1e-12)
+        np.testing.assert_allclose(
+            ht.degrees(ht.array(np.radians(d))).numpy(), d, atol=1e-9
+        )
+
+    def test_conjugate(self):
+        z = np.array([1 + 2j, 3 - 4j])
+        np.testing.assert_allclose(ht.conjugate(ht.array(z)).numpy(), np.conjugate(z))
+
+
+class TestDtypeAliases(TestCase):
+    def test_alias_identity(self):
+        self.assertIs(ht.bool_, ht.bool)
+        self.assertIs(ht.half, ht.float16)
+        self.assertIs(ht.cfloat, ht.complex64)
+        self.assertIs(ht.cdouble, ht.complex128)
+        self.assertIs(ht.float_, ht.float32)
+        self.assertIs(ht.ubyte, ht.uint8)
+
+    def test_hierarchy_predicates(self):
+        self.assertTrue(issubclass(ht.int32, ht.signedinteger))
+        self.assertTrue(issubclass(ht.uint8, ht.unsignedinteger))
+        self.assertTrue(issubclass(ht.float32, ht.flexible) or issubclass(ht.float32, ht.number))
+        self.assertTrue(ht.heat_type_is_exact(ht.int64))
+        self.assertFalse(ht.heat_type_is_exact(ht.float32))
+        self.assertTrue(ht.heat_type_is_complexfloating(ht.complex64))
+        self.assertIs(ht.heat_type_of(np.float64(1.0)), ht.float64)
+
+    def test_can_cast(self):
+        self.assertTrue(ht.can_cast(ht.int32, ht.int64))
+        self.assertFalse(ht.can_cast(ht.float64, ht.int32, casting="safe"))
+
+    def test_float16_array(self):
+        a = ht.ones(4, dtype=ht.float16, split=0)
+        self.assertEqual(a.dtype, ht.float16)
+        self.assertAlmostEqual(a.sum().item(), 4.0)
+
+
+class TestEstimatorPredicates(TestCase):
+    def test_predicates(self):
+        km = ht.cluster.KMeans(n_clusters=2)
+        knn_cls = ht.classification.KNeighborsClassifier
+        self.assertTrue(ht.is_estimator(km))
+        knn = knn_cls(n_neighbors=1)
+        self.assertTrue(ht.is_classifier(knn))
+        self.assertFalse(ht.is_classifier(km))
+        lasso = ht.regression.Lasso()
+        self.assertTrue(ht.is_regressor(lasso))
+        self.assertIsInstance(km, ht.BaseEstimator)
+        self.assertIsInstance(km, ht.ClusteringMixin)
+        self.assertIsInstance(lasso, ht.RegressionMixin)
+        self.assertIsInstance(knn, ht.ClassificationMixin)
+
+    def test_get_set_params(self):
+        km = ht.cluster.KMeans(n_clusters=3)
+        params = km.get_params()
+        self.assertEqual(params["n_clusters"], 3)
+        km.set_params(n_clusters=5)
+        self.assertEqual(km.get_params()["n_clusters"], 5)
+
+
+class TestUtilitiesSweep(TestCase):
+    def test_printoptions_roundtrip(self):
+        old = ht.get_printoptions()
+        try:
+            ht.set_printoptions(precision=3)
+            self.assertEqual(ht.get_printoptions()["precision"], 3)
+        finally:
+            ht.set_printoptions(**old)
+
+    def test_device_and_comm(self):
+        d = ht.Device("cpu", 0)
+        self.assertEqual(d.device_type, "cpu")
+        comm = ht.sanitize_comm(None)
+        self.assertGreaterEqual(comm.size, 1)
+        ht.use_comm(comm)  # set default back to itself
+
+    def test_broadcast_shapes(self):
+        self.assertEqual(ht.broadcast_shapes((3, 1), (1, 4)), (3, 4))
+        self.assertEqual(ht.broadcast_shape((2, 1), (2, 5)), (2, 5))
+
+    def test_sanitize_utils(self):
+        self.assertEqual(ht.sanitize_axis((3, 4), -1), 1)
+        self.assertEqual(ht.sanitize_shape(5), (5,))
+        x = ht.ones(3, split=0)
+        ht.sanitize_in(x)
+        with self.assertRaises(TypeError):
+            ht.sanitize_in(np.ones(3))
+        s = ht.scalar_to_1d(ht.array(5))
+        self.assertEqual(s.shape, (1,))
+
+    def test_from_partitioned(self):
+        a = ht.from_partitioned(np.arange(6.0))
+        np.testing.assert_array_equal(a.numpy(), np.arange(6.0))

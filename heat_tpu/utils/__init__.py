@@ -1,4 +1,4 @@
 """Utility subpackages (reference: heat/utils/__init__.py, plus the
 TPU-build-new checkpoint and profiling subsystems called for by SURVEY.md §5)."""
 
-from . import checkpoint, data, profiling
+from . import checkpoint, data, health, profiling

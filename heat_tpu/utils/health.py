@@ -1,0 +1,121 @@
+"""Mesh health / failure detection utilities.
+
+The reference's failure story is MPI's: a dead rank aborts the job and
+SLURM restarts it (SURVEY.md §5 — no in-framework detection). On TPU the
+failure modes are different — a tunnel/backend can hang rather than die —
+so this module gives the runtime an explicit health surface:
+
+* :func:`ping_mesh` — one tiny psum over every mesh device with a wall-clock
+  budget, returning status + latency (run in a worker thread so a hung
+  backend cannot hang the caller).
+* :func:`assert_mesh_healthy` — raise if the mesh does not answer in time.
+* :func:`memory_report` — live device-buffer bytes per device (leak triage).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.communication import MeshCommunication, sanitize_comm
+
+__all__ = ["ping_mesh", "assert_mesh_healthy", "memory_report"]
+
+
+class MeshUnhealthyError(RuntimeError):
+    """The device mesh failed to answer a collective within the budget."""
+
+
+def _ping(comm: MeshCommunication) -> float:
+    """One tiny all-device psum; returns the observed wall latency."""
+    from jax.sharding import PartitionSpec as P
+
+    start = time.perf_counter()
+    x = jax.device_put(
+        jnp.arange(comm.size, dtype=jnp.float32), comm.sharding(1, 0)
+    )
+    fn = jax.jit(
+        jax.shard_map(
+            lambda s: jax.lax.psum(s, comm.axis_name),
+            mesh=comm.mesh,
+            in_specs=P(comm.axis_name),
+            out_specs=P(comm.axis_name),
+            check_vma=False,
+        )
+    )
+    out = fn(x)
+    total = float(jnp.sum(out))  # host sync
+    expect = float(comm.size) * sum(range(comm.size))
+    if total != expect:
+        raise MeshUnhealthyError(
+            f"collective returned {total}, expected {expect} — mesh state corrupt"
+        )
+    return time.perf_counter() - start
+
+
+def ping_mesh(comm: Optional[MeshCommunication] = None, timeout: float = 60.0) -> dict:
+    """Probe the mesh with one collective under a wall-clock budget.
+
+    Returns ``{"ok", "latency_s", "devices", "platform", "error"}``. A hung
+    backend (the axon tunnel's observed failure mode) yields ``ok=False``
+    with ``error="timeout"`` instead of hanging the caller — the probe runs
+    in a worker thread.
+    """
+    comm = sanitize_comm(comm)
+    info = {
+        "ok": False,
+        "latency_s": None,
+        "devices": comm.size,
+        "platform": comm.devices[0].platform if comm.devices else "?",
+        "error": None,
+    }
+    with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
+        fut = pool.submit(_ping, comm)
+        try:
+            info["latency_s"] = round(fut.result(timeout=timeout), 6)
+            info["ok"] = True
+        except concurrent.futures.TimeoutError:
+            info["error"] = "timeout"
+        except Exception as exc:  # noqa: BLE001
+            info["error"] = f"{type(exc).__name__}: {exc}"
+    return info
+
+
+def assert_mesh_healthy(comm: Optional[MeshCommunication] = None, timeout: float = 60.0) -> dict:
+    """Raise :class:`MeshUnhealthyError` unless :func:`ping_mesh` succeeds."""
+    info = ping_mesh(comm, timeout=timeout)
+    if not info["ok"]:
+        raise MeshUnhealthyError(f"mesh health probe failed: {info}")
+    return info
+
+
+def memory_report(comm: Optional[MeshCommunication] = None) -> dict:
+    """Live device-buffer bytes per device (and total), from
+    ``jax.live_arrays()`` — the leak-triage companion of the reference's
+    (non-existent) memory tooling; exceeds reference scope like
+    utils/profiling does."""
+    comm = sanitize_comm(comm)
+    per_device: dict = {}
+    total = 0
+    for arr in jax.live_arrays():
+        try:
+            shards = arr.addressable_shards
+        except Exception:  # pragma: no cover - deleted/donated buffers
+            continue
+        for s in shards:
+            nbytes = int(np_prod(s.data.shape) * s.data.dtype.itemsize)
+            key = str(s.device)
+            per_device[key] = per_device.get(key, 0) + nbytes
+            total += nbytes
+    return {"total_bytes": total, "per_device_bytes": per_device}
+
+
+def np_prod(shape) -> int:
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out

@@ -163,3 +163,32 @@ class TestProfiling:
         assert isinstance(stats, dict)
         for v in stats.values():
             assert all(isinstance(b, int) for b in v.values())
+
+
+class TestHealth:
+    def test_ping_mesh(self):
+        info = ht.utils.health.ping_mesh(timeout=120.0)
+        assert info["ok"], info
+        assert info["devices"] == ht.get_comm().size
+        assert info["latency_s"] > 0.0
+
+    def test_assert_mesh_healthy(self):
+        info = ht.utils.health.assert_mesh_healthy(timeout=120.0)
+        assert info["ok"]
+
+    def test_unhealthy_raises(self):
+        from heat_tpu.utils import health
+
+        orig = health._ping
+        health._ping = lambda comm: (_ for _ in ()).throw(RuntimeError("boom"))
+        try:
+            with pytest.raises(health.MeshUnhealthyError):
+                health.assert_mesh_healthy(timeout=5.0)
+        finally:
+            health._ping = orig
+
+    def test_memory_report(self):
+        keep = ht.ones((64, 4), split=0)  # noqa: F841 - held live for the report
+        rep = ht.utils.health.memory_report()
+        assert rep["total_bytes"] > 0
+        assert len(rep["per_device_bytes"]) >= 1

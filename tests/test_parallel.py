@@ -1,0 +1,157 @@
+"""Tensor / pipeline / expert parallelism (beyond-reference first-class
+strategies, SURVEY.md §2.3-7): each strategy against its dense oracle on the
+test mesh, plus HLO checks that TP emits exactly the Megatron-style
+collective pattern."""
+
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import heat_tpu as ht
+from heat_tpu.parallel.expert import MoELayer, moe_apply
+from heat_tpu.parallel.pipeline import pipeline_apply, pipeline_stage_params
+from heat_tpu.parallel.tensor import ColumnParallelDense, RowParallelDense, TPMLPBlock
+
+from harness import TestCase
+
+
+def _tp_mesh(p):
+    return Mesh(np.array(jax.devices()[:p]), ("tp",))
+
+
+class TestTensorParallel(TestCase):
+    def test_tp_mlp_matches_dense(self):
+        p = self.get_size()
+        mesh = _tp_mesh(p)
+        model = TPMLPBlock(hidden=8 * p, features=8)
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 8), jnp.float32)
+        variables = model.init(jax.random.PRNGKey(1), x)
+        # oracle: same params, no mesh (plain matmuls)
+        dense = model.apply(variables, x)
+        with mesh:
+            sharded = jax.jit(lambda v, xx: model.apply(v, xx))(variables, x)
+        np.testing.assert_allclose(np.asarray(sharded), np.asarray(dense), atol=1e-5)
+
+    def test_tp_block_single_allreduce(self):
+        p = self.get_size()
+        if p == 1:
+            self.skipTest("tp schedule needs a distributed mesh")
+        mesh = _tp_mesh(p)
+        model = TPMLPBlock(hidden=8 * p, features=8)
+        x = jnp.zeros((4, 8), jnp.float32)
+        variables = model.init(jax.random.PRNGKey(1), x)
+        # shard the params per their partitioning metadata and pin the input
+        from flax.core import unfreeze
+
+        def shard_leaf(leaf):
+            if hasattr(leaf, "names"):
+                sh = NamedSharding(mesh, P(*leaf.names))
+                return jax.device_put(leaf.unbox(), sh)
+            return leaf
+
+        params = jax.tree.map(
+            shard_leaf, variables["params"], is_leaf=lambda l: hasattr(l, "names")
+        )
+        with mesh:
+            fn = jax.jit(lambda v, xx: model.apply({"params": v}, xx))
+            hlo = fn.lower(params, x).compile().as_text()
+        # the Megatron pattern: the row-parallel psum is the only collective
+        # family present (XLA may split it), and NOTHING is all-gathered —
+        # neither activations nor the sharded kernels
+        n_ar = len(re.findall(r" = [^\n]*all-reduce", hlo))
+        self.assertGreaterEqual(n_ar, 1, hlo[:200])
+        self.assertLessEqual(n_ar, 2, hlo[:200])
+        self.assertNotIn("all-gather", hlo)
+
+    def test_column_then_row_shapes(self):
+        p = self.get_size()
+        mesh = _tp_mesh(p)
+        x = jax.random.normal(jax.random.PRNGKey(2), (3, 6), jnp.float32)
+        col = ColumnParallelDense(4 * p)
+        cv = col.init(jax.random.PRNGKey(3), x)
+        with mesh:
+            h = col.apply(cv, x)
+        self.assertEqual(h.shape, (3, 4 * p))
+        row = RowParallelDense(6)
+        rv = row.init(jax.random.PRNGKey(4), h)
+        with mesh:
+            y = row.apply(rv, h)
+        self.assertEqual(y.shape, (3, 6))
+
+
+class TestPipelineParallel(TestCase):
+    def test_pipeline_matches_sequential(self):
+        p = self.get_size()
+        mesh = Mesh(np.array(jax.devices()[:p]), ("pp",))
+        rng = np.random.default_rng(0)
+        d = 6
+        stage_params = [
+            {
+                "w": jnp.asarray((rng.standard_normal((d, d)) * 0.3).astype(np.float32)),
+                "b": jnp.asarray((rng.standard_normal(d) * 0.1).astype(np.float32)),
+            }
+            for _ in range(p)
+        ]
+
+        def stage_fn(params, act):
+            return jnp.tanh(act @ params["w"] + params["b"])
+
+        stacked = pipeline_stage_params(stage_params)
+        batch = 4 * p
+        x = jnp.asarray(rng.standard_normal((batch, d)).astype(np.float32))
+        out = pipeline_apply(stage_fn, stacked, x, mesh, axis="pp")
+        # oracle: sequential through the stages
+        ref = x
+        for sp in stage_params:
+            ref = stage_fn(sp, ref)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_pipeline_microbatch_validation(self):
+        p = self.get_size()
+        mesh = Mesh(np.array(jax.devices()[:p]), ("pp",))
+        stacked = pipeline_stage_params([{"w": jnp.eye(2)} for _ in range(p)])
+        with pytest.raises(ValueError):
+            pipeline_apply(
+                lambda sp, a: a @ sp["w"],
+                stacked,
+                jnp.zeros((3 * p + 1, 2)),
+                mesh,
+                n_microbatches=3 * p if p > 1 else 2,
+            )
+
+
+class TestExpertParallel(TestCase):
+    def test_moe_matches_dense_oracle(self):
+        p = self.get_size()
+        mesh = Mesh(np.array(jax.devices()[:p]), ("ep",))
+        d = 4
+        model = MoELayer(n_experts=p, hidden=8, features=d)
+        x = jax.random.normal(jax.random.PRNGKey(5), (8 * p, d), jnp.float32)
+        variables = model.init(jax.random.PRNGKey(6), x)
+        dense = model.apply(variables, x)
+        xs = jax.device_put(x, NamedSharding(mesh, P("ep", None)))
+        sharded = model.apply(variables, xs, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(sharded), np.asarray(dense), atol=1e-4)
+
+    def test_moe_capacity_drops_match_contract(self):
+        # tokens beyond per-destination capacity are dropped to zero rows by
+        # the dispatch; with few tokens per device the routing stays exact
+        p = self.get_size()
+        if p == 1:
+            self.skipTest("expert exchange needs a distributed mesh")
+        mesh = Mesh(np.array(jax.devices()[:p]), ("ep",))
+        d = 4
+        rng = np.random.default_rng(1)
+        router = jnp.asarray(rng.standard_normal((d, p)).astype(np.float32))
+        wi = jnp.asarray(rng.standard_normal((p, d, 6)).astype(np.float32))
+        wo = jnp.asarray(rng.standard_normal((p, 6, d)).astype(np.float32))
+        x = jax.random.normal(jax.random.PRNGKey(7), (2 * p, d), jnp.float32)
+        xs = jax.device_put(x, NamedSharding(mesh, P("ep", None)))
+        out = moe_apply(MoELayer.expert_fn, (wi, wo), router, xs, mesh, "ep")
+        self.assertEqual(out.shape, x.shape)
+        self.assertTrue(np.isfinite(np.asarray(out)).all())

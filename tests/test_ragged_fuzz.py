@@ -1,0 +1,92 @@
+"""Property sweep for the pad+mask core: long deterministic chains of mixed
+operations over ragged (non-divisible) split arrays, compared against numpy
+after every step. Padding garbage escaping into results — the core hazard of
+the physical-padding design — shows up here as a divergence mid-chain."""
+
+import numpy as np
+
+import heat_tpu as ht
+
+from harness import TestCase
+
+
+def _ops(rng):
+    """(name, heat_fn, numpy_fn) elementwise/reduction steps; all keep the
+    array 1-D so chains compose."""
+    c = float(rng.uniform(0.5, 2.0))
+    return [
+        ("add", lambda a: a + c, lambda a: a + c),
+        ("mul", lambda a: a * c, lambda a: a * c),
+        ("sub_arr", lambda a: a - a / 2, lambda a: a - a / 2),
+        ("div", lambda a: a / c, lambda a: a / c),
+        ("sin", ht.sin, np.sin),
+        ("exp", lambda a: ht.exp(a * 0.1), lambda a: np.exp(a * 0.1)),
+        ("abs", ht.abs, np.abs),
+        ("clip", lambda a: ht.clip(a, -2.0, 2.0), lambda a: np.clip(a, -2.0, 2.0)),
+        ("sqrt_abs", lambda a: ht.sqrt(ht.abs(a)), lambda a: np.sqrt(np.abs(a))),
+        ("cumsum", lambda a: ht.cumsum(a, 0), lambda a: np.cumsum(a)),
+        ("neg", lambda a: -a, lambda a: -a),
+        ("square", lambda a: a * a, lambda a: a * a),
+    ]
+
+
+class TestRaggedOpChains(TestCase):
+    def test_chains_match_numpy(self):
+        p = self.get_size()
+        rng = np.random.default_rng(42)
+        ops = _ops(rng)
+        for trial in range(6):
+            n = int(rng.integers(2, 6)) * p + int(rng.integers(1, max(p, 2)))
+            a_np = rng.standard_normal(n)
+            a = ht.array(a_np, split=0)
+            order = rng.permutation(len(ops))[:8]
+            for step, j in enumerate(order):
+                name, hfn, nfn = ops[j]
+                a = hfn(a)
+                a_np = nfn(a_np)
+                np.testing.assert_allclose(
+                    a.numpy(),
+                    a_np,
+                    rtol=1e-10,
+                    atol=1e-10,
+                    err_msg=f"trial {trial} step {step} op {name} n={n}",
+                )
+                # scalar reductions stay masked throughout the chain
+                self.assertAlmostEqual(a.sum().item(), a_np.sum(), places=8)
+            self.assertEqual(a.split, 0)
+            if p > 1 and n >= p:
+                self.assertTrue(a.padded or n % p == 0)
+
+    def test_mixed_binary_chain(self):
+        p = self.get_size()
+        rng = np.random.default_rng(7)
+        n = 3 * p + 2
+        a_np = rng.standard_normal(n)
+        b_np = rng.standard_normal(n)
+        a, b = ht.array(a_np, split=0), ht.array(b_np, split=0)
+        for i in range(10):
+            a = a * b + 0.5
+            a_np = a_np * b_np + 0.5
+            b = b - a / 3.0
+            b_np = b_np - a_np / 3.0
+            np.testing.assert_allclose(a.numpy(), a_np, rtol=1e-9, atol=1e-9)
+            np.testing.assert_allclose(b.numpy(), b_np, rtol=1e-9, atol=1e-9)
+        self.assertAlmostEqual(a.mean().item(), a_np.mean(), places=8)
+        self.assertAlmostEqual(b.std().item(), b_np.std(), places=8)
+
+    def test_2d_chain_with_reductions(self):
+        p = self.get_size()
+        rng = np.random.default_rng(11)
+        m, k = 2 * p + 1, 3
+        a_np = rng.standard_normal((m, k))
+        a = ht.array(a_np, split=0)
+        for i in range(5):
+            a = ht.exp(a * 0.1) - 1.0
+            a_np = np.exp(a_np * 0.1) - 1.0
+            np.testing.assert_allclose(
+                a.sum(axis=1).numpy(), a_np.sum(axis=1), rtol=1e-9, atol=1e-10
+            )
+            np.testing.assert_allclose(
+                a.max(axis=0).numpy(), a_np.max(axis=0), rtol=1e-9, atol=1e-10
+            )
+        np.testing.assert_allclose(a.numpy(), a_np, rtol=1e-9, atol=1e-10)

@@ -38,6 +38,20 @@ def _median_step(data: jax.Array, centers: jax.Array, k: int):
     return new_centers, labels, inertia, shift
 
 
+@partial(jax.jit, static_argnames=("k", "n_steps"))
+def _median_run(data: jax.Array, centers: jax.Array, k: int, n_steps: int):
+    """``n_steps`` fused iterations in ONE XLA program (the kmeans
+    ``_lloyd_run`` pattern: one dispatch per chunk instead of per step)."""
+
+    def body(i, carry):
+        centers, _, _, _ = carry
+        return _median_step.__wrapped__(data, centers, k)
+
+    # the first step seeds the carry with the exact output types
+    first = _median_step.__wrapped__(data, centers, k)
+    return jax.lax.fori_loop(1, n_steps, body, first)
+
+
 class KMedians(_KCluster):
     """K-Medians clustering (reference kmedians.py:14-139)."""
 
@@ -70,12 +84,17 @@ class KMedians(_KCluster):
         centers = self._initialize_cluster_centers(x)
 
         labels = inertia = None
-        for it in range(self.max_iter):
-            centers, labels, inertia, shift = _median_step(data, centers, self.n_clusters)
-            if float(shift) <= self.tol:
+        done = 0
+        while done < self.max_iter:
+            # fused chunks of up to 8 iterations per dispatch; convergence
+            # checked at chunk boundaries (the kmeans pattern)
+            chunk = min(8, self.max_iter - done)
+            centers, labels, inertia, shift = _median_run(data, centers, self.n_clusters, chunk)
+            done += chunk
+            if float(shift) <= getattr(self, "tol", 0.0):
                 break
 
-        self._n_iter = it + 1
+        self._n_iter = done
         self._inertia = float(inertia) if inertia is not None else None
         self._cluster_centers = DNDarray(
             _ensure_split(centers, None, x.comm),

@@ -91,7 +91,7 @@ class KMedians(_KCluster):
             chunk = min(8, self.max_iter - done)
             centers, labels, inertia, shift = _median_run(data, centers, self.n_clusters, chunk)
             done += chunk
-            if float(shift) <= getattr(self, "tol", 0.0):
+            if float(shift) <= self.tol:
                 break
 
         self._n_iter = done

@@ -242,7 +242,7 @@ def diff(a, n: int = 1, axis: int = -1, prepend=None, append=None) -> DNDarray:
     # computes on the logical view explicitly
     sanitation.sanitize_in(a)
     result = jnp.diff(a.larray, n=n, axis=axis, **kw)
-    split = a.split if result.ndim == a.ndim else None
+    split = a.split  # diff never changes rank
     result = _ensure_split(result, split, a.comm)
     return DNDarray(
         result,

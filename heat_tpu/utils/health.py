@@ -14,7 +14,8 @@ so this module gives the runtime an explicit health surface:
 
 from __future__ import annotations
 
-import concurrent.futures
+import queue
+import threading
 import time
 from typing import Optional
 
@@ -73,15 +74,29 @@ def ping_mesh(comm: Optional[MeshCommunication] = None, timeout: float = 60.0) -
         "platform": comm.devices[0].platform if comm.devices else "?",
         "error": None,
     }
-    with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
-        fut = pool.submit(_ping, comm)
+    # a DAEMON thread, not an executor: ThreadPoolExecutor.shutdown (and the
+    # interpreter's atexit join of its non-daemon workers) would block on a
+    # hung backend — the exact failure this probe exists to bound
+    result: "queue.Queue" = queue.Queue(maxsize=1)
+
+    def run():
         try:
-            info["latency_s"] = round(fut.result(timeout=timeout), 6)
-            info["ok"] = True
-        except concurrent.futures.TimeoutError:
-            info["error"] = "timeout"
+            result.put(("ok", _ping(comm)))
         except Exception as exc:  # noqa: BLE001
-            info["error"] = f"{type(exc).__name__}: {exc}"
+            result.put(("err", f"{type(exc).__name__}: {exc}"))
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    try:
+        kind, val = result.get(timeout=timeout)
+    except queue.Empty:
+        info["error"] = "timeout"
+        return info
+    if kind == "ok":
+        info["latency_s"] = round(val, 6)
+        info["ok"] = True
+    else:
+        info["error"] = val
     return info
 
 
@@ -94,11 +109,12 @@ def assert_mesh_healthy(comm: Optional[MeshCommunication] = None, timeout: float
 
 
 def memory_report(comm: Optional[MeshCommunication] = None) -> dict:
-    """Live device-buffer bytes per device (and total), from
-    ``jax.live_arrays()`` — the leak-triage companion of the reference's
+    """Live device-buffer bytes per device of ``comm``'s mesh (and total),
+    from ``jax.live_arrays()`` — the leak-triage companion of the reference's
     (non-existent) memory tooling; exceeds reference scope like
     utils/profiling does."""
     comm = sanitize_comm(comm)
+    mesh_devices = {str(d) for d in comm.devices}
     per_device: dict = {}
     total = 0
     for arr in jax.live_arrays():
@@ -107,15 +123,10 @@ def memory_report(comm: Optional[MeshCommunication] = None) -> dict:
         except Exception:  # pragma: no cover - deleted/donated buffers
             continue
         for s in shards:
-            nbytes = int(np_prod(s.data.shape) * s.data.dtype.itemsize)
             key = str(s.device)
+            if key not in mesh_devices:
+                continue
+            nbytes = int(s.data.nbytes)
             per_device[key] = per_device.get(key, 0) + nbytes
             total += nbytes
     return {"total_bytes": total, "per_device_bytes": per_device}
-
-
-def np_prod(shape) -> int:
-    out = 1
-    for s in shape:
-        out *= int(s)
-    return out

@@ -398,7 +398,7 @@ def median(x: DNDarray, axis: Optional[int] = None, keepdims: bool = False, keep
         keepdims = keepdim  # torch-style alias of the reference
     sanitation.sanitize_in(x)
     axis = sanitize_axis(x.shape, axis)
-    if axis is None and x.split is not None and x.is_distributed() and not x.padded:
+    if axis is None and x.split is not None and not x.padded:
         return percentile(x, 50.0, keepdims=keepdims)  # gather-free bisection
     data = x.larray
     if types.heat_type_is_exact(x.dtype):
@@ -474,7 +474,7 @@ def percentile(
     if types.heat_type_is_exact(x.dtype):
         data = data.astype(types.promote_types(x.dtype, types.float32).jax_type())
 
-    if axis is None and x.split is not None and x.is_distributed() and not x.padded:
+    if axis is None and x.split is not None and not x.padded:
         n = x.size
         flat = data.reshape(-1)
         pos = qa / 100.0 * (n - 1)

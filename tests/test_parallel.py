@@ -119,9 +119,9 @@ class TestPipelineParallel(TestCase):
             pipeline_apply(
                 lambda sp, a: a @ sp["w"],
                 stacked,
-                jnp.zeros((3 * p + 1, 2)),
+                jnp.zeros((3 * p + 1, 2)),  # never divisible by 3p
                 mesh,
-                n_microbatches=3 * p if p > 1 else 2,
+                n_microbatches=3 * p,
             )
 
 

@@ -1,0 +1,116 @@
+"""Reference setitem/getitem behavioral sweep (reference
+heat/core/tests/test_dndarray.py:1056-1496, incl. the bug #825 slice-assign
+and bug #730 split-bookkeeping patterns)."""
+
+import numpy as np
+
+import heat_tpu as ht
+
+from harness import TestCase
+
+
+class TestSetitemGetitemReference(TestCase):
+    def test_slice_assign_split_values_825(self):
+        # interior slice assignment from a split DNDarray (reference bug #825)
+        a = ht.ones((102, 102), split=0)
+        setting = ht.zeros((100, 100), split=0)
+        a[1:-1, 1:-1] = setting
+        self.assertTrue(bool(ht.all(a[1:-1, 1:-1] == 0)))
+        # border stays ones
+        self.assertTrue(bool(ht.all(a[0] == 1)))
+        self.assertTrue(bool(ht.all(a[:, -1] == 1)))
+
+        a = ht.ones((102, 102), split=1)
+        setting = ht.zeros((30, 100), split=1)
+        a[-30:, 1:-1] = setting
+        self.assertTrue(bool(ht.all(a[-30:, 1:-1] == 0)))
+
+        a = ht.ones((102, 102), split=1)
+        a[1:-1, :20] = ht.zeros((100, 20), split=1)
+        self.assertTrue(bool(ht.all(a[1:-1, :20] == 0)))
+
+    def test_split_bookkeeping_730(self):
+        # split follows the surviving dimensions (reference bug #730)
+        a = ht.ones((10, 25, 30), split=1)
+        if a.comm.size > 1:
+            self.assertEqual(a[0].split, 0)
+            self.assertEqual(a[:, 0, :].split, None)
+            self.assertEqual(a[:, :, 0].split, 1)
+
+    def test_single_value_set_get(self):
+        a = ht.zeros((13, 5), split=0)
+        a[10, np.array(0)] = 1
+        self.assertEqual(float(a[10, 0].item()), 1.0)
+        self.assertEqual(a[10, 0].dtype, ht.float32)
+
+        a = ht.zeros((13, 5), split=0)
+        a[10] = 1
+        b = a[10]
+        self.assertTrue(bool((b == 1).all()))
+        self.assertEqual(b.gshape, (5,))
+
+        a = ht.zeros((13, 5), split=0)
+        a[-1] = 1
+        b = a[-1]
+        self.assertTrue(bool((b == 1).all()))
+        self.assertEqual(b.gshape, (5,))
+
+    def test_slice_metadata(self):
+        a = ht.zeros((13, 5), split=0)
+        a[1:4] = 1
+        self.assertTrue(bool((a[1:4] == 1).all()))
+        self.assertEqual(a[1:4].gshape, (3, 5))
+        self.assertEqual(a[1:4].split, 0)
+        self.assertEqual(a[1:4].dtype, ht.float32)
+
+        a = ht.zeros((13, 5), split=0)
+        a[1:2] = 1
+        self.assertEqual(a[1:2].gshape, (1, 5))
+        self.assertEqual(a[1:2].split, 0)
+
+        a = ht.zeros((13, 5), split=0)
+        a[1:4, 1] = 1
+        b = a[1:4, np.int64(1)]
+        self.assertTrue(bool((b == 1).all()))
+        self.assertEqual(b.gshape, (3,))
+        self.assertEqual(b.split, 0)
+
+        a = ht.zeros((13, 5), split=0)
+        a[1:11, 1] = 1
+        self.assertTrue(bool((a[1:11, 1] == 1).all()))
+        self.assertEqual(a[1:11, 1].gshape, (10,))
+
+    def test_split1_columns(self):
+        a = ht.zeros((13, 5), split=1)
+        a[:, 2] = 1
+        self.assertTrue(bool((a[:, 2] == 1).all()))
+        self.assertEqual(a[:, 2].gshape, (13,))
+        a[3, :] = 2
+        self.assertTrue(bool((a[3, :] == 2).all()))
+        self.assertEqual(a[3].gshape, (5,))
+
+    def test_cross_split_value_assignment(self):
+        # value split differs from destination split: implicit resplit
+        a = ht.ones((12, 6), split=0)
+        v = ht.zeros((12, 6), split=1)
+        a[:, :] = v
+        self.assertTrue(bool(ht.all(a == 0)))
+        self.assertEqual(a.split, 0)
+
+    def test_scalar_dtype_preserved(self):
+        a = ht.zeros((6, 4), split=0, dtype=ht.int32)
+        a[2] = 7
+        self.assertEqual(a.dtype, ht.int32)
+        self.assertEqual(int(a[2, 0].item()), 7)
+
+    def test_negative_step_get(self):
+        a_np = np.arange(26.0).reshape(13, 2)
+        a = ht.array(a_np, split=0)
+        np.testing.assert_array_equal(a[::-1].numpy(), a_np[::-1])
+        np.testing.assert_array_equal(a[10:2:-2].numpy(), a_np[10:2:-2])
+
+    def test_getitem_with_dndarray_index(self):
+        a_np = np.arange(20.0)
+        a = ht.array(a_np, split=0)
+        idx = ht.array(np.array([0, 5, 19]), split=0)
+        np.testing.assert_array_equal(a[idx].numpy(), a_np[[0, 5, 19]])

@@ -71,8 +71,8 @@ class TestRaggedOpChains(TestCase):
             b_np = b_np - a_np / 3.0
             np.testing.assert_allclose(a.numpy(), a_np, rtol=1e-9, atol=1e-9)
             np.testing.assert_allclose(b.numpy(), b_np, rtol=1e-9, atol=1e-9)
-        self.assertAlmostEqual(a.mean().item(), a_np.mean(), places=8)
-        self.assertAlmostEqual(b.std().item(), b_np.std(), places=8)
+        np.testing.assert_allclose(a.mean().item(), a_np.mean(), rtol=1e-9)
+        np.testing.assert_allclose(b.std().item(), b_np.std(), rtol=1e-9)
 
     def test_2d_chain_with_reductions(self):
         p = self.get_size()

@@ -256,6 +256,21 @@ def main() -> None:
     def note(phase, outcome):
         log.append({"t": round(time.time() - t0, 1), "phase": phase, "outcome": str(outcome)[:200]})
 
+    # 0) provisional record FIRST: a quick tiny CPU measurement printed
+    #    immediately, so even if the driver's (unknown) timeout kills this
+    #    process mid-probe-window, a parseable record with an honest metric
+    #    name exists — the empty-record failure mode is impossible. Any
+    #    later TPU/full-CPU record is printed after it and wins as the
+    #    last line.
+    env = os.environ.copy()
+    env["HEAT_BENCH_PLATFORM"] = "cpu"
+    env["HEAT_BENCH_SCALE"] = "0.05"
+    rec, err = _try_once(env, timeout=600)
+    note("cpu_provisional", "ok" if rec else err[-120:])
+    if rec:
+        rec["provisional"] = True
+        print(json.dumps(rec), flush=True)
+
     last_err = ""
     # 1) default backend (TPU when available): re-probe every ~60s across the
     #    probe window — the tunnel has been observed down for many minutes at
@@ -277,7 +292,7 @@ def main() -> None:
         note("tpu_full", "ok" if rec else err[-120:])
         if rec:
             rec["probe_log"] = log[-20:]
-            print(json.dumps(rec))
+            print(json.dumps(rec), flush=True)
             return
         last_err = err
         # reduced-size TPU attempt before any CPU fallback
@@ -287,7 +302,7 @@ def main() -> None:
         note("tpu_reduced", "ok" if rec else err[-120:])
         if rec:
             rec["probe_log"] = log[-20:]
-            print(json.dumps(rec))
+            print(json.dumps(rec), flush=True)
             return
         last_err = err
         break  # backend is up but the worker fails: don't loop the window out
@@ -301,7 +316,7 @@ def main() -> None:
     note("cpu_fallback", "ok" if rec else err[-120:])
     if rec:
         rec["probe_log"] = log[-30:]
-        print(json.dumps(rec))
+        print(json.dumps(rec), flush=True)
         return
     print(
         json.dumps(
@@ -313,7 +328,8 @@ def main() -> None:
                 "error": (err or last_err)[-800:],
                 "probe_log": log[-30:],
             }
-        )
+        ),
+        flush=True,
     )
 
 

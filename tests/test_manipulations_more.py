@@ -1,0 +1,150 @@
+"""More manipulations depth, modeled on the reference's deep sweeps
+(reference heat/core/tests/test_manipulations.py: diag/diagonal offsets,
+rot90 turns, expand/squeeze errors, flatten/ravel across splits, the
+hsplit/vsplit/dsplit family)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from harness import TestCase
+
+
+class TestDiagFamily(TestCase):
+    def test_diag_vector_to_matrix_offsets(self):
+        v_np = np.arange(1.0, 6.0)
+        for split in (None, 0):
+            v = ht.array(v_np, split=split)
+            for off in (-2, -1, 0, 1, 3):
+                np.testing.assert_array_equal(
+                    ht.diag(v, off).numpy(), np.diag(v_np, off), err_msg=f"off={off}"
+                )
+
+    def test_diag_matrix_to_vector_offsets(self):
+        a_np = np.arange(30.0).reshape(5, 6)
+        for split in (None, 0, 1):
+            a = ht.array(a_np, split=split)
+            for off in (-3, -1, 0, 2, 5):
+                np.testing.assert_array_equal(
+                    ht.diag(a, off).numpy(), np.diag(a_np, off), err_msg=f"off={off}"
+                )
+
+    def test_diagonal_dim_pairs(self):
+        a_np = np.arange(24.0).reshape(2, 3, 4)
+        a = ht.array(a_np, split=0)
+        np.testing.assert_array_equal(
+            ht.diagonal(a, 0, 1, 2).numpy(), np.diagonal(a_np, 0, 1, 2)
+        )
+        np.testing.assert_array_equal(
+            ht.diagonal(a, 1, 0, 2).numpy(), np.diagonal(a_np, 1, 0, 2)
+        )
+
+
+class TestRot90Tile(TestCase):
+    def test_rot90_all_turns(self):
+        a_np = np.arange(12.0).reshape(3, 4)
+        for split in (None, 0, 1):
+            a = ht.array(a_np, split=split)
+            for k in (0, 1, 2, 3, 4, -1):
+                np.testing.assert_array_equal(
+                    ht.rot90(a, k).numpy(), np.rot90(a_np, k), err_msg=f"k={k}"
+                )
+
+    def test_rot90_axes(self):
+        a_np = np.arange(24.0).reshape(2, 3, 4)
+        a = ht.array(a_np, split=0)
+        np.testing.assert_array_equal(
+            ht.rot90(a, 1, axes=(1, 2)).numpy(), np.rot90(a_np, 1, axes=(1, 2))
+        )
+
+    def test_tile_2d_reps(self):
+        a_np = np.arange(6.0).reshape(2, 3)
+        for split in (None, 0, 1):
+            a = ht.array(a_np, split=split)
+            for reps in (2, (2, 1), (1, 3), (2, 2)):
+                np.testing.assert_array_equal(
+                    ht.tile(a, reps).numpy(), np.tile(a_np, reps), err_msg=str(reps)
+                )
+
+
+class TestExpandSqueezeErrors(TestCase):
+    def test_expand_dims_positions(self):
+        a_np = np.arange(6.0).reshape(2, 3)
+        a = ht.array(a_np, split=0)
+        for ax in (0, 1, 2, -1):
+            np.testing.assert_array_equal(
+                ht.expand_dims(a, ax).numpy(), np.expand_dims(a_np, ax)
+            )
+
+    def test_expand_dims_out_of_range(self):
+        with pytest.raises((ValueError, IndexError, TypeError)):
+            ht.expand_dims(ht.ones((2, 2)), 5)
+
+    def test_squeeze_errors(self):
+        a = ht.ones((2, 1, 3), split=0)
+        with pytest.raises((ValueError, TypeError)):
+            ht.squeeze(a, 0)  # dim 0 is not singular
+
+    def test_squeeze_all_and_axis(self):
+        a_np = np.arange(6.0).reshape(1, 2, 1, 3)
+        a = ht.array(a_np)
+        np.testing.assert_array_equal(ht.squeeze(a).numpy(), a_np.squeeze())
+        np.testing.assert_array_equal(ht.squeeze(a, 0).numpy(), a_np.squeeze(0))
+        np.testing.assert_array_equal(ht.squeeze(a, 2).numpy(), a_np.squeeze(2))
+
+
+class TestFlattenRavelSplits(TestCase):
+    def test_flatten_all_splits(self):
+        p = self.get_size()
+        a_np = np.arange((2 * p + 1) * 3.0).reshape(2 * p + 1, 3)
+        for split in (None, 0, 1):
+            a = ht.array(a_np, split=split)
+            out = ht.flatten(a)
+            np.testing.assert_array_equal(out.numpy(), a_np.flatten())
+            out2 = ht.ravel(a)
+            np.testing.assert_array_equal(out2.numpy(), a_np.ravel())
+
+    def test_flatten_keeps_distribution(self):
+        p = self.get_size()
+        a = ht.ones((4 * p, 2), split=0)
+        out = ht.flatten(a)
+        if p > 1:
+            self.assertEqual(out.split, 0)
+
+
+class TestSplitFamily(TestCase):
+    def test_hsplit_vsplit_dsplit(self):
+        a_np = np.arange(48.0).reshape(4, 4, 3)
+        a = ht.array(a_np, split=0)
+        for got, exp in zip(ht.vsplit(a, 2), np.vsplit(a_np, 2)):
+            np.testing.assert_array_equal(got.numpy(), exp)
+        for got, exp in zip(ht.hsplit(a, 2), np.hsplit(a_np, 2)):
+            np.testing.assert_array_equal(got.numpy(), exp)
+        for got, exp in zip(ht.dsplit(a, 3), np.dsplit(a_np, 3)):
+            np.testing.assert_array_equal(got.numpy(), exp)
+
+    def test_split_by_indices(self):
+        a_np = np.arange(20.0).reshape(10, 2)
+        a = ht.array(a_np, split=0)
+        for got, exp in zip(ht.split(a, [2, 7]), np.split(a_np, [2, 7])):
+            np.testing.assert_array_equal(got.numpy(), exp)
+
+    def test_split_uneven_sections_error(self):
+        with pytest.raises((ValueError, TypeError)):
+            ht.split(ht.ones((10, 2), split=0), 3)
+
+
+class TestBroadcastOps(TestCase):
+    def test_broadcast_to(self):
+        a_np = np.arange(3.0)
+        a = ht.array(a_np, split=0)
+        out = ht.broadcast_to(a, (4, 3))
+        np.testing.assert_array_equal(out.numpy(), np.broadcast_to(a_np, (4, 3)))
+
+    def test_broadcast_arrays(self):
+        a = ht.ones((3, 1), split=0)
+        b = ht.ones((1, 4))
+        x, y = ht.broadcast_arrays(a, b)
+        self.assertEqual(x.shape, (3, 4))
+        self.assertEqual(y.shape, (3, 4))

@@ -100,6 +100,24 @@ def worker() -> None:
     iters_per_sec = ITERS / best
     lloyd_tflops = _flops_per_lloyd_iter(n) * iters_per_sec / 1e12
 
+    # the primary measurement is banked IMMEDIATELY: everything after this
+    # line (diagnostics, the other three configs) can hang on a flaky tunnel,
+    # and the parent salvages the last parseable stdout line on timeout
+    print(
+        json.dumps(
+            {
+                "metric": _metric_name(n),
+                "value": round(iters_per_sec, 3),
+                "unit": "iters/s",
+                "vs_baseline": None,
+                "platform": platform,
+                "n": n,
+                "partial": "kmeans only; a later full record supersedes this line",
+            }
+        ),
+        flush=True,
+    )
+
     # -- cdist GB/s/chip (config 2) ---------------------------------------
     from heat_tpu.spatial.distance import _euclidian_fast
 
@@ -163,24 +181,64 @@ def worker() -> None:
     except Exception:
         vs = float("nan")
 
-    print(
-        json.dumps(
-            {
-                "metric": _metric_name(n),
-                "value": round(iters_per_sec, 3),
-                "unit": "iters/s",
-                "vs_baseline": round(vs, 2),
-                "platform": platform,
-                "n": n,
-                "lloyd_tflops": round(lloyd_tflops, 3),
-                "cdist_gbps_per_chip": round(cd_gbps, 2),
-                "cdist_n": cd_n,
-                "moments_ms_1M": round(moments_ms, 3),
-                "qr_tflops": round(qr_tflops, 3),
-                "qr_shape": [qr_m, QR_N],
-            }
-        )
-    )
+    record = {
+        "metric": _metric_name(n),
+        "value": round(iters_per_sec, 3),
+        "unit": "iters/s",
+        "vs_baseline": round(vs, 2),
+        "platform": platform,
+        "n": n,
+        "lloyd_tflops": round(lloyd_tflops, 3),
+        "cdist_gbps_per_chip": round(cd_gbps, 2),
+        "cdist_n": cd_n,
+        "moments_ms_1M": round(moments_ms, 3),
+        "qr_tflops": round(qr_tflops, 3),
+        "qr_shape": [qr_m, QR_N],
+    }
+    # the COMPLETE record is banked before any diagnostics run: a hang below
+    # costs only the two diagnostic fields, never the tracked configs
+    print(json.dumps(record), flush=True)
+
+    # dispatch round-trip floor: every measurement above synchronized via one
+    # host scalar read, and on the tunneled axon backend that round trip is a
+    # fixed cost that dominates small configs — measure it so the artifact is
+    # interpretable on its own
+    try:
+        tiny = jax.jit(lambda a: a.sum())
+        tv = jnp.ones(8)
+        float(tiny(tv))
+        rtt = float("inf")
+        for _ in range(3):
+            start = time.perf_counter()
+            float(tiny(tv))
+            rtt = min(rtt, time.perf_counter() - start)
+        record["dispatch_rtt_ms"] = round(rtt * 1e3, 2)
+    except Exception:  # noqa: BLE001 - diagnostics must never cost the record
+        pass
+
+    # two-point marginal rate: a second fused program with 3x the iterations;
+    # the time difference cancels every fixed per-dispatch cost (tunnel RTT,
+    # argument transfer), yielding the steady-state per-iteration rate the
+    # reference's 30-iteration on-node protocol sees. Only accepted when the
+    # 3x run is >=1.5x the 1x time — otherwise the subtraction is noise (that
+    # floor also bounds the reported rate at 4x the raw measurement).
+    try:
+        _, _, _, shift3 = _lloyd_run(data, centers, K, 3 * ITERS)
+        float(shift3)  # compile
+        best3 = float("inf")
+        for _ in range(2):
+            start = time.perf_counter()
+            _, _, _, shift3 = _lloyd_run(data, centers, K, 3 * ITERS)
+            float(shift3)
+            best3 = min(best3, time.perf_counter() - start)
+        if best3 >= 1.5 * best:
+            record["lloyd_iters_per_sec_marginal"] = round((3 * ITERS - ITERS) / (best3 - best), 3)
+    except Exception:  # noqa: BLE001 - diagnostics must never cost the record
+        pass
+
+    # final superseding line: the complete record plus whatever diagnostics
+    # succeeded (identical tracked fields — last parseable line wins)
+    print(json.dumps(record), flush=True)
 
 
 def _torch_cpu_iters_per_sec(n: int, iters: int = 2) -> float:
@@ -205,8 +263,39 @@ def _torch_cpu_iters_per_sec(n: int, iters: int = 2) -> float:
     return iters / (time.perf_counter() - start)
 
 
-def _try_once(env: dict, timeout: float) -> tuple:
-    """Run the worker in a child process; return (record or None, err_tail)."""
+def _last_kmeans_record(stdout, allow_partial: bool):
+    """Last parseable kmeans record in captured stdout, or None.
+
+    ``allow_partial`` admits the mid-run banked line (kmeans only, no
+    cdist/moments/qr fields) — wanted when salvaging a timed-out worker,
+    rejected for a worker that *crashed* partway (a retry at reduced size or
+    on CPU can still produce a complete record there).
+    """
+    if isinstance(stdout, bytes):
+        stdout = stdout.decode(errors="replace")
+    for line in reversed((stdout or "").strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except (ValueError, TypeError):
+            continue
+        if not (isinstance(rec, dict) and str(rec.get("metric", "")).startswith("kmeans_iters")):
+            continue
+        if "partial" in rec and not allow_partial:
+            continue
+        return rec
+    return None
+
+
+def _try_once(env: dict, timeout: float, accept_partial_on_crash: bool = False) -> tuple:
+    """Run the worker in a child process; return (record or None, err_tail).
+
+    A returned record may be *incomplete*: the worker banks a kmeans-only
+    line right after the primary measurement, so a hang (timeout salvage) or
+    — when ``accept_partial_on_crash``, meant for the ladder's final attempt
+    — a crash in a later config still yields the primary number. Callers can
+    detect this via the record's ``partial``/``salvaged_after_timeout_s``
+    keys and keep trying for a complete one.
+    """
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--_worker"],
@@ -215,18 +304,29 @@ def _try_once(env: dict, timeout: float) -> tuple:
             text=True,
             timeout=timeout,
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as exc:
+        rec = _last_kmeans_record(exc.stdout, allow_partial=True)
+        if rec is not None:
+            rec["salvaged_after_timeout_s"] = timeout
+            return rec, ""
         return None, f"worker timed out after {timeout}s"
     except Exception as exc:  # noqa: BLE001
         return None, repr(exc)
-    for line in reversed(proc.stdout.strip().splitlines()):
-        try:
-            rec = json.loads(line)
-        except (ValueError, TypeError):
-            continue
-        if isinstance(rec, dict) and str(rec.get("metric", "")).startswith("kmeans_iters"):
-            return rec, ""
+    rec = _last_kmeans_record(
+        proc.stdout, allow_partial=proc.returncode == 0 or accept_partial_on_crash
+    )
+    if rec is not None:
+        if proc.returncode != 0 and "partial" in rec:
+            rec["worker_crashed_after_banking"] = (proc.stderr or "")[-300:]
+        return rec, ""
     return None, (proc.stderr or proc.stdout or "no output")[-2000:]
+
+
+def _is_incomplete(rec: dict) -> bool:
+    # only the kmeans-only banked line carries "partial"; a timeout-salvaged
+    # record that already has all tracked configs is complete (the worker
+    # flushes it before running diagnostics)
+    return "partial" in rec
 
 
 def _probe_backend(env: dict, timeout: float = 90.0) -> bool:
@@ -252,6 +352,9 @@ def main() -> None:
 
     t0 = time.time()
     log = []  # probe/attempt trail, shipped in the JSON
+    banked_tpu = None  # best incomplete TPU record, re-printed last if the
+    # ladder ends on a CPU/error line — a partial TPU number outranks a
+    # complete CPU one for the headline metric
 
     def note(phase, outcome):
         log.append({"t": round(time.time() - t0, 1), "phase": phase, "outcome": str(outcome)[:200]})
@@ -275,7 +378,6 @@ def main() -> None:
     # 1) default backend (TPU when available): re-probe every ~60s across the
     #    probe window — the tunnel has been observed down for many minutes at
     #    a stretch; a late TPU number beats an early CPU one
-    attempted_full = False
     while time.time() - t0 < PROBE_WINDOW_S:
         ok = _probe_backend(os.environ.copy())
         note("probe", "up" if ok else "down")
@@ -287,24 +389,36 @@ def main() -> None:
             time.sleep(PROBE_EVERY_S)
             continue
         # full-size attempt
-        attempted_full = True
         rec, err = _try_once(os.environ.copy(), timeout=1500)
-        note("tpu_full", "ok" if rec else err[-120:])
+        note("tpu_full", ("partial" if rec and _is_incomplete(rec) else "ok") if rec else err[-120:])
         if rec:
             rec["probe_log"] = log[-20:]
             print(json.dumps(rec), flush=True)
-            return
-        last_err = err
+            if not _is_incomplete(rec):
+                return
+            # an incomplete record is banked (it wins if nothing better
+            # lands as a later line) but the ladder continues toward a
+            # complete one with cdist/moments/qr and vs_baseline
+            if rec.get("platform") != "cpu":
+                banked_tpu = rec
+            last_err = "full-size record incomplete"
+        else:
+            last_err = err
         # reduced-size TPU attempt before any CPU fallback
         env = os.environ.copy()
         env["HEAT_BENCH_SCALE"] = "0.2"
         rec, err = _try_once(env, timeout=1200)
-        note("tpu_reduced", "ok" if rec else err[-120:])
+        note("tpu_reduced", ("partial" if rec and _is_incomplete(rec) else "ok") if rec else err[-120:])
         if rec:
             rec["probe_log"] = log[-20:]
             print(json.dumps(rec), flush=True)
-            return
-        last_err = err
+            if not _is_incomplete(rec):
+                return
+            if rec.get("platform") != "cpu":
+                banked_tpu = banked_tpu or rec  # full-size partial outranks
+            last_err = "reduced-size record incomplete"
+        else:
+            last_err = err
         break  # backend is up but the worker fails: don't loop the window out
 
     # 2) CPU fallback — a degraded number beats an empty record. (The axon
@@ -312,25 +426,30 @@ def main() -> None:
     #    this choice via jax.config after import.)
     env = os.environ.copy()
     env["HEAT_BENCH_PLATFORM"] = "cpu"
-    rec, err = _try_once(env, timeout=1500)
+    rec, err = _try_once(env, timeout=1500, accept_partial_on_crash=True)
     note("cpu_fallback", "ok" if rec else err[-120:])
     if rec:
         rec["probe_log"] = log[-30:]
         print(json.dumps(rec), flush=True)
-        return
-    print(
-        json.dumps(
-            {
-                "metric": _metric_name(N_FULL),
-                "value": None,
-                "unit": "iters/s",
-                "vs_baseline": None,
-                "error": (err or last_err)[-800:],
-                "probe_log": log[-30:],
-            }
-        ),
-        flush=True,
-    )
+    else:
+        print(
+            json.dumps(
+                {
+                    "metric": _metric_name(N_FULL),
+                    "value": None,
+                    "unit": "iters/s",
+                    "vs_baseline": None,
+                    "error": (err or last_err)[-800:],
+                    "probe_log": log[-30:],
+                }
+            ),
+            flush=True,
+        )
+    if banked_tpu is not None:
+        # last line wins: the (incomplete) TPU measurement outranks whatever
+        # the CPU fallback produced; the CPU line stays above for diagnostics
+        banked_tpu["reprinted_over_cpu_fallback"] = True
+        print(json.dumps(banked_tpu), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Interactive heat_tpu console.
+
+The reference ships an MPI-aware REPL (`/root/reference/scripts/interactive.py`)
+whose whole job is prompt/stdin choreography across ranks under
+``mpirun -stdin all``. Under heat_tpu's single-controller model there is
+exactly one Python process no matter how many devices the mesh has, so a
+plain interpreter suffices — the TPU rendering of "interactive" is a banner
+that shows the live mesh and a preloaded namespace.
+
+Run:  python scripts/interactive.py [--devices N]
+      (--devices forces an N-device CPU mesh for experimentation)
+"""
+
+import argparse
+import code
+import os
+import sys
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--devices",
+        type=int,
+        default=None,
+        help="force an N-virtual-device CPU mesh (for trying out split semantics "
+        "without accelerators)",
+    )
+    args = parser.parse_args()
+
+    if args.devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}"
+        )
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+
+    import heat_tpu as ht
+
+    comm = ht.get_comm()
+    banner = (
+        f"heat_tpu {ht.__version__} interactive console\n"
+        f"mesh: {comm.size} x {jax.devices()[0].platform} "
+        f"({', '.join(str(d) for d in comm.devices[:4])}"
+        f"{', ...' if comm.size > 4 else ''})\n"
+        f"preloaded: ht (heat_tpu), jax, jnp, np\n"
+        f'try: ht.arange(12, split=0), ht.random.randn(4, 4), x.resplit_(1)'
+    )
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    namespace = {"ht": ht, "jax": jax, "jnp": jnp, "np": np}
+    try:
+        import readline  # noqa: F401 - line editing when available
+    except ImportError:  # pragma: no cover
+        pass
+    code.interact(banner=banner, local=namespace, exitmsg="")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

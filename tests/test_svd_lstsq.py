@@ -1,0 +1,98 @@
+"""Oracle tests for the beyond-reference svd/lstsq additions."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from harness import TestCase
+
+
+def _reconstruct(u, s, vh):
+    return np.asarray(u.larray) @ np.diag(np.asarray(s.larray)) @ np.asarray(vh.larray)
+
+
+class TestSVD(TestCase):
+    def test_tall_all_splits(self):
+        rng = np.random.default_rng(0)
+        a_np = rng.standard_normal((24, 4)).astype(np.float32)
+        for split in (None, 0, 1):
+            a = ht.resplit(ht.array(a_np), split)
+            u, s, vh = ht.linalg.svd(a)
+            np.testing.assert_allclose(_reconstruct(u, s, vh), a_np, atol=1e-4)
+            # singular values match numpy's (descending, non-negative)
+            np.testing.assert_allclose(
+                np.asarray(s.larray), np.linalg.svd(a_np, compute_uv=False), rtol=1e-4, atol=1e-4
+            )
+            # orthonormal factors
+            utu = np.asarray(u.larray).T @ np.asarray(u.larray)
+            np.testing.assert_allclose(utu, np.eye(4), atol=1e-4)
+            if split == 0:
+                assert u.split == 0  # sharding-preserving tall factor
+
+    def test_wide_via_transpose(self):
+        rng = np.random.default_rng(1)
+        a_np = rng.standard_normal((3, 17)).astype(np.float32)
+        for split in (None, 0, 1):
+            a = ht.resplit(ht.array(a_np), split)
+            u, s, vh = ht.linalg.svd(a)
+            assert u.shape == (3, 3) and vh.shape == (3, 17)
+            np.testing.assert_allclose(_reconstruct(u, s, vh), a_np, atol=1e-4)
+
+    def test_singular_values_only(self):
+        rng = np.random.default_rng(2)
+        a_np = rng.standard_normal((10, 5)).astype(np.float32)
+        s = ht.linalg.svd(ht.array(a_np, split=0), compute_uv=False)
+        np.testing.assert_allclose(
+            np.asarray(s.larray), np.linalg.svd(a_np, compute_uv=False), rtol=1e-4, atol=1e-4
+        )
+
+    def test_ragged_rows(self):
+        rng = np.random.default_rng(3)
+        a_np = rng.standard_normal((13, 3)).astype(np.float32)  # prime rows
+        u, s, vh = ht.linalg.svd(ht.array(a_np, split=0))
+        np.testing.assert_allclose(_reconstruct(u, s, vh), a_np, atol=1e-4)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ht.linalg.svd(ht.ones((2, 3, 4)))
+        with pytest.raises(NotImplementedError):
+            ht.linalg.svd(ht.ones((4, 3)), full_matrices=True)
+
+
+class TestLstsq(TestCase):
+    def test_overdetermined_matches_numpy(self):
+        rng = np.random.default_rng(4)
+        a_np = rng.standard_normal((20, 4)).astype(np.float32)
+        b_np = rng.standard_normal(20).astype(np.float32)
+        expected = np.linalg.lstsq(a_np, b_np, rcond=None)[0]
+        for split in (None, 0):
+            a = ht.resplit(ht.array(a_np), split)
+            b = ht.resplit(ht.array(b_np), split)
+            x = ht.linalg.lstsq(a, b)
+            np.testing.assert_allclose(np.asarray(x.larray), expected, rtol=1e-3, atol=1e-3)
+
+    def test_multiple_rhs(self):
+        rng = np.random.default_rng(5)
+        a_np = rng.standard_normal((16, 3)).astype(np.float32)
+        b_np = rng.standard_normal((16, 2)).astype(np.float32)
+        expected = np.linalg.lstsq(a_np, b_np, rcond=None)[0]
+        x = ht.linalg.lstsq(ht.array(a_np, split=0), ht.array(b_np, split=0))
+        assert x.shape == (3, 2)
+        np.testing.assert_allclose(np.asarray(x.larray), expected, rtol=1e-3, atol=1e-3)
+
+    def test_exact_solution_recovered(self):
+        rng = np.random.default_rng(6)
+        a_np = rng.standard_normal((12, 4)).astype(np.float32)
+        x_true = rng.standard_normal(4).astype(np.float32)
+        b = a_np @ x_true
+        x = ht.linalg.lstsq(ht.array(a_np, split=0), ht.array(b, split=0))
+        np.testing.assert_allclose(np.asarray(x.larray), x_true, rtol=1e-3, atol=1e-3)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ht.linalg.lstsq(ht.ones((3, 5)), ht.ones(3))  # underdetermined
+        with pytest.raises(ValueError):
+            ht.linalg.lstsq(ht.ones((5, 2)), ht.ones(4))  # mismatched b
+        with pytest.raises(NotImplementedError):
+            ht.linalg.lstsq(ht.ones((5, 2)), ht.ones(5), rcond=1e-6)

@@ -236,6 +236,25 @@ def worker() -> None:
     except Exception:  # noqa: BLE001 - diagnostics must never cost the record
         pass
 
+    # fused pallas Lloyd kernel (ops/lloyd.py): single data pass per
+    # iteration vs the jnp path's two contraction reads — measured side by
+    # side; the headline stays on the default path until this wins on HW
+    try:
+        from heat_tpu.ops.lloyd import fused_lloyd_run, fused_supported
+
+        if fused_supported(n, F, K):
+            _, _, _, fshift = fused_lloyd_run(data, centers, K, ITERS)
+            float(fshift)  # compile
+            fbest = float("inf")
+            for _ in range(3):
+                start = time.perf_counter()
+                _, _, _, fshift = fused_lloyd_run(data, centers, K, ITERS)
+                float(fshift)
+                fbest = min(fbest, time.perf_counter() - start)
+            record["lloyd_fused_iters_per_sec"] = round(ITERS / fbest, 3)
+    except Exception:  # noqa: BLE001 - diagnostics must never cost the record
+        pass
+
     # final superseding line: the complete record plus whatever diagnostics
     # succeeded (identical tracked fields — last parseable line wins)
     print(json.dumps(record), flush=True)

@@ -1,0 +1,126 @@
+"""One-chip TPU capability probe.
+
+Measures the framework's hot kernels on the real TPU and prints one JSON
+document: MXU matmul rates (bf16/f32), the pallas flash-attention kernel,
+cdist (jnp quadratic expansion vs the pallas pairwise kernel), and an HBM
+bandwidth probe. Every timing synchronizes via a host scalar read and is
+reported both raw and with the measured dispatch round-trip subtracted
+(the axon tunnel adds a fixed per-dispatch cost that a real on-host run
+does not pay).
+
+Usage: python benchmarks/tpu_capability.py [--out FILE]
+"""
+
+import argparse
+import json
+import time
+
+
+def _timeit(fn, sync, reps=3):
+    """Best-of-reps wall time of fn(); sync(result) forces completion."""
+    sync(fn())  # warmup/compile
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        sync(fn())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    out = {"device": str(dev), "platform": dev.platform}
+
+    # dispatch round-trip floor
+    tiny = jax.jit(lambda a: a.sum())
+    tv = jnp.ones(8)
+    rtt = _timeit(lambda: tiny(tv), lambda r: float(r), reps=5)
+    out["dispatch_rtt_ms"] = round(rtt * 1e3, 2)
+
+    def corrected(best):
+        return max(best - rtt, 1e-9)
+
+    # ---- MXU matmul rates ------------------------------------------------
+    for dtype, name in ((jnp.bfloat16, "bf16"), (jnp.float32, "f32")):
+        n = 4096
+        a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.float32).astype(dtype)
+        b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.float32).astype(dtype)
+        mm = jax.jit(lambda x, y: (x @ y).astype(jnp.float32))
+        best = _timeit(lambda: mm(a, b), lambda r: float(r[0, 0]))
+        flops = 2.0 * n * n * n
+        out[f"matmul_{name}_{n}_tflops"] = round(flops / best / 1e12, 2)
+        out[f"matmul_{name}_{n}_tflops_rtt_corrected"] = round(flops / corrected(best) / 1e12, 2)
+
+    # ---- HBM bandwidth: big elementwise triad ----------------------------
+    n = 64 * 1024 * 1024  # 256 MB per operand f32
+    x = jax.random.normal(jax.random.PRNGKey(2), (n,), jnp.float32)
+    y = jax.random.normal(jax.random.PRNGKey(3), (n,), jnp.float32)
+    triad = jax.jit(lambda a, b: (a * 1.5 + b).sum())  # read 2n, reduce
+    best = _timeit(lambda: triad(x, y), lambda r: float(r))
+    bytes_moved = 2 * n * 4
+    out["hbm_read_gbps"] = round(bytes_moved / best / 1e9, 1)
+    out["hbm_read_gbps_rtt_corrected"] = round(bytes_moved / corrected(best) / 1e9, 1)
+
+    # ---- flash attention (pallas) vs dense reference ---------------------
+    try:
+        from heat_tpu.nn.attention import dot_product_attention
+        from heat_tpu.ops.flash import flash_attention_tpu as flash_attention
+
+        B, S, H, D = 1, 4096, 8, 128
+        q, k, v = (
+            jax.random.normal(kk, (B, S, H, D), jnp.float32)
+            for kk in jax.random.split(jax.random.PRNGKey(4), 3)
+        )
+        # 4 matmul-equivalent flops per (S,S,D) score+value pair, halved causal
+        att_flops = 4.0 * B * H * S * S * D / 2
+        fl = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+        best = _timeit(lambda: fl(q, k, v), lambda r: float(r[0, 0, 0, 0]))
+        out["flash_attn_causal_4k_tflops"] = round(att_flops / best / 1e12, 2)
+        out["flash_attn_causal_4k_tflops_rtt_corrected"] = round(
+            att_flops / corrected(best) / 1e12, 2
+        )
+        dn = jax.jit(lambda q, k, v: dot_product_attention(q, k, v, causal=True))
+        best_d = _timeit(lambda: dn(q, k, v), lambda r: float(r[0, 0, 0, 0]))
+        out["dense_attn_causal_4k_tflops"] = round(att_flops / best_d / 1e12, 2)
+        out["flash_vs_dense_speedup"] = round(best_d / best, 2)
+    except Exception as exc:  # noqa: BLE001
+        out["flash_attn_error"] = repr(exc)[:200]
+
+    # ---- cdist: jnp quadratic expansion vs pallas pairwise ---------------
+    try:
+        from heat_tpu.spatial.distance import _euclidian_fast
+
+        n, f = 16384, 64
+        pts = jax.random.normal(jax.random.PRNGKey(5), (n, f), jnp.float32)
+        cd = jax.jit(_euclidian_fast)
+        best = _timeit(lambda: cd(pts, pts), lambda r: float(r[0, 0]))
+        bytes_min = 2 * n * f * 4 + n * n * 4
+        out["cdist_jnp_16k_gbps"] = round(bytes_min / best / 1e9, 1)
+        out["cdist_jnp_16k_gbps_rtt_corrected"] = round(bytes_min / corrected(best) / 1e9, 1)
+        try:
+            from heat_tpu.ops.pairwise import pairwise_distance
+
+            pd = jax.jit(pairwise_distance)
+            best_p = _timeit(lambda: pd(pts, pts), lambda r: float(r[0, 0]))
+            out["cdist_pallas_16k_gbps"] = round(bytes_min / best_p / 1e9, 1)
+        except Exception as exc:  # noqa: BLE001
+            out["cdist_pallas_error"] = repr(exc)[:200]
+    except Exception as exc:  # noqa: BLE001
+        out["cdist_error"] = repr(exc)[:200]
+
+    doc = json.dumps(out, indent=1)
+    print(doc)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(doc + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,188 @@
+"""Fused single-pass Lloyd iteration (pallas).
+
+The jnp Lloyd step (`cluster/kmeans.py:_lloyd_iter`) necessarily reads the
+(n, f) data from HBM twice per iteration — once for the assignment matmul
+``x @ cᵀ`` and once for the update matmul ``onehotᵀ @ x`` — and materializes
+the (n, k) one-hot operand for the MXU. At the benchmark shape (10M x 16
+f32) the iteration is pure HBM bandwidth, so the floor is set by bytes
+moved, not FLOPs.
+
+This kernel streams each row block into VMEM ONCE and produces everything
+the iteration needs in that single pass:
+
+    score   = |c|² − 2·xb @ cᵀ          (block, k)   MXU
+    labels  = argmin(score)              (block,)
+    inertia += Σ min(score)              scalar accumulator
+    onehot  = (labels == arange(k))      (block, k)  VMEM-only
+    sums   += onehotᵀ @ xb               (k, f)      MXU accumulator
+    counts += Σ onehot                   (k,)        accumulator
+
+HBM traffic per iteration: n·f reads + n label writes — ~2x less than the
+fused-by-XLA jnp path (which cannot merge two contractions over the same
+operand into one read). The centroid update (k x f, tiny) runs outside.
+
+The feature axis is NOT padded to the 128-lane width in HBM — blocks are
+DMA'd as (block, f) and padded only in VMEM — so the bandwidth advantage
+survives small f (f=16 padded in HBM would octuple the bytes).
+
+Like ops/pairwise.py, the jnp path stays the default until the kernel is
+measured faster on real hardware; today bench.py is the only consumer (the
+``lloyd_fused_iters_per_sec`` field measures it side by side with the jnp
+path). Single-device only for now: the pallas_call has no partitioning
+spec, so a mesh-sharded operand would be gathered — the multi-chip path is
+a shard_map wrapper (per-device kernel + psum of sums/counts), not written
+yet.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["fused_lloyd_iter", "fused_lloyd_run", "fused_supported"]
+
+def _block_rows(f: int) -> int:
+    """Rows per grid step, sized so one (BLOCK, f) f32 input block stays
+    ≤ 4 MB (≈8 MB with pallas's input double-buffering — comfortably inside
+    the ~16 MB VMEM budget with the accumulators)."""
+    return max(512, min(8192, ((1 << 22) // (4 * f)) // 8 * 8))
+
+
+def fused_supported(n: int, f: int, k: int) -> bool:
+    """TPU backend, single device (the kernel has no partitioning spec —
+    a sharded operand would be gathered), and lane-safe k."""
+    try:
+        backend_ok = jax.default_backend() in ("tpu", "axon")
+        single = len(jax.devices()) == 1
+    except Exception:  # pragma: no cover
+        return False
+    return backend_ok and single and f <= 512 and k <= 128
+
+
+def _lloyd_kernel(
+    x_ref,
+    csq_ref,
+    cT_ref,
+    lab_ref,
+    sums_ref,
+    counts_ref,
+    inertia_ref,
+    *,
+    k: int,
+    n_valid: int,
+    block: int,
+):
+    """One (block, f) row block; accumulators live across the whole grid.
+    Rows at global index >= n_valid (tail-block padding) are masked out of
+    every accumulator."""
+    i = pl.program_id(0)
+
+    xb = x_ref[:, :]  # (block, f)
+    # (block, k) assignment scores; |x|² omitted (row-constant for argmin)
+    score = csq_ref[:, :] - 2.0 * jnp.dot(
+        xb, cT_ref[:, :], preferred_element_type=jnp.float32
+    )
+    labels = jnp.argmin(score, axis=1).astype(jnp.int32)  # (block,)
+    lab_ref[:, :] = labels[:, None]
+
+    # 2-D iotas: Mosaic does not lower 1-D iota
+    klane = jax.lax.broadcasted_iota(jnp.int32, (1, k), 1)
+    rows = i * block + jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0)
+    valid = (rows < n_valid).astype(xb.dtype)  # (BLOCK, 1)
+    onehot = (labels[:, None] == klane).astype(xb.dtype) * valid  # (BLOCK, k)
+
+    @pl.when(i == 0)
+    def _init():
+        sums_ref[:, :] = jnp.zeros_like(sums_ref)
+        counts_ref[:, :] = jnp.zeros_like(counts_ref)
+        inertia_ref[:, :] = jnp.zeros_like(inertia_ref)
+
+    sums_ref[:, :] += jnp.dot(onehot.T, xb, preferred_element_type=jnp.float32).astype(
+        sums_ref.dtype
+    )
+    counts_ref[:, :] += jnp.sum(onehot, axis=0, dtype=counts_ref.dtype)[None, :]
+    masked_min = jnp.min(score, axis=1) * valid[:, 0].astype(jnp.float32)
+    inertia_ref[:, :] += jnp.sum(masked_min, dtype=inertia_ref.dtype)[None, None]
+
+
+@functools.partial(jax.jit, static_argnames=("k", "interpret"))
+def fused_lloyd_iter(
+    data: jax.Array, centers: jax.Array, k: int, xsq_sum=None, interpret: bool = False
+):
+    """One Lloyd iteration in a single data pass.
+
+    Returns ``(new_centers, labels, inertia, shift)`` with the same contract
+    as ``cluster.kmeans._lloyd_iter`` (inertia includes the Σ|x|² term).
+    ``xsq_sum`` is the loop-invariant Σ|x|²; pass it from outside an
+    iteration loop, or it is computed here (costing the one extra data read
+    the kernel exists to avoid).
+    """
+    n, f = data.shape
+    csq = jnp.sum(centers * centers, axis=1, dtype=jnp.float32)[None, :]  # (1, k)
+    cT = centers.T.astype(data.dtype)  # (f, k)
+
+    x = data.astype(jnp.float32) if data.dtype == jnp.float64 else data
+    block = _block_rows(f)
+    n_pad = -(-n // block) * block
+    if n_pad != n:
+        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
+
+    labels2d, sums, counts, inertia = pl.pallas_call(
+        functools.partial(_lloyd_kernel, k=k, n_valid=n, block=block),
+        out_shape=(
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((k, f), jnp.float32),
+            jax.ShapeDtypeStruct((1, k), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ),
+        grid=(n_pad // block,),
+        in_specs=[
+            pl.BlockSpec((block, f), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((f, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((block, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, f), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(x, csq, cT)
+
+    counts = counts[0]
+    labels = labels2d[:n, 0]
+    new_centers = jnp.where(
+        counts[:, None] > 0,
+        sums / jnp.maximum(counts[:, None], 1.0),
+        centers.astype(jnp.float32),
+    ).astype(centers.dtype)
+    if xsq_sum is None:
+        x32 = data.astype(jnp.float32)
+        xsq_sum = jnp.sum(x32 * x32)
+    inertia_full = jnp.maximum(inertia[0, 0] + xsq_sum, 0.0)
+    shift = jnp.sum((new_centers - centers).astype(jnp.float32) ** 2)
+    return new_centers, labels, inertia_full, shift
+
+
+@functools.partial(jax.jit, static_argnames=("k", "n_steps", "interpret"))
+def fused_lloyd_run(
+    data: jax.Array, centers: jax.Array, k: int, n_steps: int, interpret: bool = False
+):
+    """``n_steps`` fused iterations in one XLA program (the pallas analog of
+    ``cluster.kmeans._lloyd_run``): Σ|x|² hoisted, one kernel pass per step."""
+    x32 = data.astype(jnp.float32)
+    xsq_sum = jnp.sum(x32 * x32)
+
+    def body(i, carry):
+        centers, _, _, _ = carry
+        return fused_lloyd_iter(data, centers, k, xsq_sum=xsq_sum, interpret=interpret)
+
+    acc = jnp.zeros((), jnp.float32)
+    return jax.lax.fori_loop(
+        0, n_steps, body, (centers, jnp.zeros(data.shape[0], jnp.int32), acc, acc)
+    )

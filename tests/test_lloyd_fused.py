@@ -1,0 +1,75 @@
+"""Fused pallas Lloyd kernel vs the jnp reference implementation.
+
+Runs in pallas interpret mode on CPU (the same strategy as
+tests/test_ops_pallas.py); real-TPU timing lives in bench.py's
+``lloyd_fused_iters_per_sec`` field.
+"""
+
+import numpy as np
+import pytest
+
+from harness import TestCase
+
+
+class TestFusedLloyd(TestCase):
+    def _compare(self, n, f, k, seed=0):
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu.cluster.kmeans import _lloyd_iter
+        from heat_tpu.ops.lloyd import fused_lloyd_iter
+
+        rng = np.random.default_rng(seed)
+        data = jnp.asarray(rng.standard_normal((n, f)).astype(np.float32))
+        centers = jnp.asarray(rng.standard_normal((k, f)).astype(np.float32) * 2)
+
+        ref_c, ref_lab, ref_inertia, ref_shift = jax.jit(
+            _lloyd_iter, static_argnames="k"
+        )(data, centers, k)
+        got_c, got_lab, got_inertia, got_shift = fused_lloyd_iter(
+            data, centers, k, interpret=True
+        )
+
+        np.testing.assert_array_equal(np.asarray(got_lab), np.asarray(ref_lab))
+        np.testing.assert_allclose(np.asarray(got_c), np.asarray(ref_c), rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(float(got_inertia), float(ref_inertia), rtol=1e-4)
+        np.testing.assert_allclose(float(got_shift), float(ref_shift), rtol=1e-4, atol=1e-6)
+
+    def test_block_multiple(self):
+        self._compare(8192, 16, 8)
+
+    def test_ragged_tail_block(self):
+        # n smaller than the row block: the single partial block must be masked
+        self._compare(5000, 16, 8, seed=1)
+
+    def test_small_n_single_partial_block(self):
+        self._compare(300, 4, 3, seed=2)
+
+    def test_wide_features_many_centers(self):
+        self._compare(2048, 64, 17, seed=3)
+
+    def test_multi_iteration_run_matches(self):
+        import jax.numpy as jnp
+
+        from heat_tpu.cluster.kmeans import _lloyd_run
+        from heat_tpu.ops.lloyd import fused_lloyd_run
+
+        rng = np.random.default_rng(4)
+        data = jnp.asarray(rng.standard_normal((4096, 8)).astype(np.float32))
+        centers = jnp.asarray(rng.standard_normal((5, 8)).astype(np.float32) * 2)
+        ref = _lloyd_run(data, centers, 5, 4)
+        got = fused_lloyd_run(data, centers, 5, 4, interpret=True)
+        np.testing.assert_allclose(np.asarray(got[0]), np.asarray(ref[0]), rtol=1e-4, atol=1e-4)
+        np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(ref[1]))
+        np.testing.assert_allclose(float(got[2]), float(ref[2]), rtol=1e-3)
+
+    def test_empty_cluster_keeps_center(self):
+        import jax.numpy as jnp
+
+        from heat_tpu.ops.lloyd import fused_lloyd_iter
+
+        data = jnp.asarray(np.zeros((128, 2), np.float32))
+        centers = jnp.asarray(np.array([[0.0, 0.0], [100.0, 100.0]], np.float32))
+        new_c, labels, _, _ = fused_lloyd_iter(data, centers, 2, interpret=True)
+        assert (np.asarray(labels) == 0).all()
+        np.testing.assert_array_equal(np.asarray(new_c)[1], centers[1])  # empty keeps old

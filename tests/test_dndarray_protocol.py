@@ -1,0 +1,166 @@
+"""DNDarray Python-protocol depth sweep.
+
+The reference's ``test_dndarray.py`` (1,639 LoC) pins the array's behavior
+as a *Python object*: every operator dunder (forward and reflected, with
+scalars and arrays), the container protocol (len/iter/contains), numpy
+interop (``__array__``), and the scalar conversion family. This suite is
+the heat_tpu rendering: every case compared against the numpy oracle across
+split axes (reference test pattern basic_test.py:142-217).
+"""
+
+import operator
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from harness import TestCase
+
+
+BINOPS = [
+    operator.add,
+    operator.sub,
+    operator.mul,
+    operator.truediv,
+    operator.floordiv,
+    operator.mod,
+    operator.pow,
+]
+CMPOPS = [operator.eq, operator.ne, operator.lt, operator.le, operator.gt, operator.ge]
+BITOPS = [operator.and_, operator.or_, operator.xor, operator.lshift, operator.rshift]
+
+
+class TestOperatorDunders(TestCase):
+    def _oracle(self, op, a_np, b_np, a_ht, b_ht):
+        expected = op(a_np, b_np)
+        got = op(a_ht, b_ht)
+        np.testing.assert_allclose(
+            np.asarray(got.larray, dtype=np.float64),
+            np.asarray(expected, dtype=np.float64),
+            rtol=1e-5,
+            atol=1e-6,
+        )
+
+    def test_forward_and_reflected_float(self):
+        rng = np.random.default_rng(0)
+        a_np = rng.uniform(0.5, 3.0, (6, 4)).astype(np.float32)
+        b_np = rng.uniform(0.5, 3.0, (6, 4)).astype(np.float32)
+        for split in (None, 0, 1):
+            a = ht.resplit(ht.array(a_np), split)
+            b = ht.resplit(ht.array(b_np), split)
+            for op in BINOPS:
+                self._oracle(op, a_np, b_np, a, b)
+                # scalar forward (a op 2) and reflected (2 op a)
+                self._oracle(op, a_np, np.float32(2.0), a, 2.0)
+                self._oracle(op, np.float32(2.0), a_np, 2.0, a)
+
+    def test_comparisons_vs_numpy(self):
+        rng = np.random.default_rng(1)
+        a_np = rng.integers(0, 4, (5, 3)).astype(np.int32)
+        b_np = rng.integers(0, 4, (5, 3)).astype(np.int32)
+        for split in (None, 0, 1):
+            a = ht.resplit(ht.array(a_np), split)
+            b = ht.resplit(ht.array(b_np), split)
+            for op in CMPOPS:
+                got = op(a, b)
+                np.testing.assert_array_equal(np.asarray(got.larray), op(a_np, b_np))
+                got_s = op(a, 2)
+                np.testing.assert_array_equal(np.asarray(got_s.larray), op(a_np, 2))
+
+    def test_bitwise_and_shifts(self):
+        rng = np.random.default_rng(2)
+        a_np = rng.integers(0, 8, (9,)).astype(np.int64)
+        b_np = rng.integers(0, 3, (9,)).astype(np.int64)
+        for split in (None, 0):
+            a = ht.resplit(ht.array(a_np), split)
+            b = ht.resplit(ht.array(b_np), split)
+            for op in BITOPS:
+                got = op(a, b)
+                np.testing.assert_array_equal(np.asarray(got.larray), op(a_np, b_np))
+
+    def test_unary_dunders(self):
+        a_np = np.array([[-2.5, 3.5], [1.0, -0.5]], np.float32)
+        for split in (None, 0, 1):
+            a = ht.resplit(ht.array(a_np), split)
+            np.testing.assert_array_equal(np.asarray((-a).larray), -a_np)
+            np.testing.assert_array_equal(np.asarray((+a).larray), +a_np)
+            np.testing.assert_array_equal(np.asarray(abs(a).larray), np.abs(a_np))
+        i = ht.array([0b101, 0b010], dtype=ht.int32, split=0)
+        np.testing.assert_array_equal(np.asarray((~i).larray), ~np.array([0b101, 0b010], np.int32))
+
+    def test_matmul_dunder_shapes(self):
+        rng = np.random.default_rng(3)
+        m_np = rng.standard_normal((6, 4)).astype(np.float32)
+        v_np = rng.standard_normal(4).astype(np.float32)
+        for split in (None, 0, 1):
+            m = ht.resplit(ht.array(m_np), split)
+            v = ht.array(v_np)
+            np.testing.assert_allclose(
+                np.asarray((m @ v).larray), m_np @ v_np, rtol=1e-5, atol=1e-5
+            )
+            np.testing.assert_allclose(
+                np.asarray((m.T @ m).larray), m_np.T @ m_np, rtol=1e-4, atol=1e-4
+            )
+
+
+class TestContainerProtocol(TestCase):
+    def test_len_matches_first_dim(self):
+        for split in (None, 0, 1):
+            x = ht.resplit(ht.zeros((7, 3)), split)
+            assert len(x) == 7
+        with pytest.raises(TypeError):
+            len(ht.array(3.0))
+
+    def test_iter_yields_rows(self):
+        x_np = np.arange(12, dtype=np.float32).reshape(4, 3)
+        for split in (None, 0, 1):
+            x = ht.resplit(ht.array(x_np), split)
+            rows = list(x)
+            assert len(rows) == 4
+            for i, row in enumerate(rows):
+                np.testing.assert_array_equal(np.asarray(row.larray), x_np[i])
+
+    def test_array_protocol_numpy_interop(self):
+        x_np = np.arange(10, dtype=np.float32)
+        for split in (None, 0):
+            x = ht.resplit(ht.array(x_np), split)
+            # np.asarray must see the LOGICAL global array (no padding rows)
+            np.testing.assert_array_equal(np.asarray(x), x_np)
+            # numpy ufunc applied to the converted value
+            np.testing.assert_allclose(np.sin(np.asarray(x)), np.sin(x_np), rtol=1e-6)
+        r = ht.arange(10, split=0)  # ragged over most mesh sizes
+        assert np.asarray(r).shape == (10,)
+
+    def test_local_size_properties(self):
+        p = ht.get_comm().size
+        x = ht.zeros((4 * p, 3), dtype=ht.float32, split=0)
+        assert x.lnumel == 4 * 3
+        assert x.lnbytes == x.lnumel * 4
+        assert x.nbytes == 4 * p * 3 * 4
+        assert x.gnumel == x.shape[0] * x.shape[1]
+
+
+class TestScalarConversions(TestCase):
+    def test_bool_int_float_complex_index(self):
+        assert bool(ht.array(True)) is True
+        assert bool(ht.array([0.0])) is False
+        assert int(ht.array([7])) == 7
+        assert float(ht.array(2.5)) == 2.5
+        assert complex(ht.array(1.5)) == 1.5 + 0j
+        # __index__: usable as a Python slice bound
+        k = ht.array(3)
+        assert list(range(10))[k:5] == [3, 4]
+
+    def test_conversion_errors_multielement(self):
+        x = ht.arange(6, split=0)
+        for cast in (bool, int, float, complex):
+            with pytest.raises((ValueError, TypeError)):
+                cast(x)
+
+    def test_item_across_splits(self):
+        for split in (None, 0):
+            x = ht.resplit(ht.arange(5, dtype=ht.int64), split)
+            assert x[3].item() == 3
+        assert isinstance(ht.array(1.5).item(), float)
+        assert isinstance(ht.array(2, dtype=ht.int32).item(), int)

@@ -1,0 +1,185 @@
+"""Operator × dtype × split matrix sweep.
+
+The reference CI's backbone is ``assert_func_equal``: every op run over a
+dtype matrix and EVERY split axis against the numpy oracle (reference
+basic_test.py:142-217, 295-306). This suite turns that crank over the core
+reduction/manipulation/elementwise surface on 2-D and 3-D shapes — broad
+shallow coverage complementing the targeted depth suites.
+"""
+
+import numpy as np
+
+import heat_tpu as ht
+
+from harness import TestCase
+
+
+class TestReductionMatrix(TestCase):
+    def test_sum(self):
+        self.assert_func_equal((4, 5), ht.sum, np.sum, rtol=1e-4, atol=1e-2)
+        self.assert_func_equal(
+            (3, 4, 5), ht.sum, np.sum, heat_args={"axis": 1}, numpy_args={"axis": 1},
+            rtol=1e-4, atol=1e-2,
+        )
+
+    def test_mean_var_std(self):
+        self.assert_func_equal((6, 5), ht.mean, np.mean, rtol=1e-4, atol=1e-3)
+        self.assert_func_equal(
+            (3, 4, 5), ht.mean, np.mean, heat_args={"axis": 0}, numpy_args={"axis": 0},
+            rtol=1e-4, atol=1e-3,
+        )
+        self.assert_func_equal(
+            (6, 5), ht.var, np.var, data_types=(np.float32, np.float64), rtol=1e-3, atol=1e-2
+        )
+        self.assert_func_equal(
+            (6, 5), ht.std, np.std, data_types=(np.float32, np.float64), rtol=1e-3, atol=1e-2
+        )
+
+    def test_min_max(self):
+        self.assert_func_equal((4, 7), ht.min, np.min)
+        self.assert_func_equal((4, 7), ht.max, np.max)
+        self.assert_func_equal(
+            (4, 7), ht.max, np.max, heat_args={"axis": 1}, numpy_args={"axis": 1}
+        )
+        self.assert_func_equal(
+            (2, 3, 4), ht.min, np.min, heat_args={"axis": 2}, numpy_args={"axis": 2}
+        )
+
+    def test_prod_small_values(self):
+        # |x| kept near 1 so the product neither overflows nor underflows
+        self.assert_func_equal(
+            (3, 4), ht.prod, np.prod,
+            data_types=(np.float32, np.float64), low=-2, high=2, rtol=1e-3, atol=1e-3,
+        )
+
+    def test_argminmax_flat(self):
+        # flat arg-reductions return the global index regardless of split
+        self.assert_func_equal((5, 4), ht.argmax, np.argmax)
+        self.assert_func_equal((5, 4), ht.argmin, np.argmin)
+
+
+class TestManipulationMatrix(TestCase):
+    def test_sort_flat_axes(self):
+        self.assert_func_equal(
+            (6, 4),
+            lambda a, **k: ht.sort(a, **k)[0],
+            np.sort,
+            heat_args={"axis": 0},
+            numpy_args={"axis": 0},
+        )
+        self.assert_func_equal(
+            (6, 4),
+            lambda a, **k: ht.sort(a, **k)[0],
+            np.sort,
+            heat_args={"axis": 1},
+            numpy_args={"axis": 1},
+        )
+
+    def test_flip_roll(self):
+        self.assert_func_equal(
+            (4, 5), ht.flip, np.flip, heat_args={"axis": 0}, numpy_args={"axis": 0}
+        )
+        self.assert_func_equal(
+            (4, 5), ht.roll, np.roll, heat_args={"shift": 2, "axis": 1},
+            numpy_args={"shift": 2, "axis": 1},
+        )
+        self.assert_func_equal(
+            (3, 4, 2), ht.roll, np.roll, heat_args={"shift": -1, "axis": 0},
+            numpy_args={"shift": -1, "axis": 0},
+        )
+
+    def test_cumops(self):
+        self.assert_func_equal(
+            (5, 4), ht.cumsum, np.cumsum, heat_args={"axis": 0}, numpy_args={"axis": 0},
+            rtol=1e-4, atol=1e-2,
+        )
+        self.assert_func_equal(
+            (5, 4), ht.cumsum, np.cumsum, heat_args={"axis": 1}, numpy_args={"axis": 1},
+            rtol=1e-4, atol=1e-2,
+        )
+        self.assert_func_equal(
+            (4, 3), ht.cumprod, np.cumprod,
+            data_types=(np.float32, np.float64),
+            heat_args={"axis": 0}, numpy_args={"axis": 0},
+            low=-2, high=2, rtol=1e-3, atol=1e-3,
+        )
+
+    def test_transpose_squeeze_expand(self):
+        self.assert_func_equal((4, 6), ht.transpose, np.transpose)
+        self.assert_func_equal(
+            (2, 3, 4),
+            ht.transpose,
+            np.transpose,
+            heat_args={"axes": (2, 0, 1)},
+            numpy_args={"axes": (2, 0, 1)},
+        )
+        self.assert_func_equal(
+            (3, 5),
+            lambda a, **k: ht.expand_dims(a, **k),
+            np.expand_dims,
+            heat_args={"axis": 1},
+            numpy_args={"axis": 1},
+        )
+
+
+class TestElementwiseMatrix(TestCase):
+    def test_composed_chain(self):
+        # a chain crossing several modules: rounding, exponential, trig
+        def ht_chain(a):
+            return ht.round(ht.exp(ht.sin(a / 100.0)) + ht.sqrt(ht.abs(a)))
+
+        def np_chain(a):
+            return np.round(np.exp(np.sin(a / 100.0)) + np.sqrt(np.abs(a)))
+
+        self.assert_func_equal(
+            (5, 6), ht_chain, np_chain, data_types=(np.float32, np.float64),
+            rtol=1e-4, atol=1e-4,
+        )
+
+    def test_where_and_clip(self):
+        self.assert_func_equal(
+            (4, 5),
+            lambda a: ht.where(a > 0, a, -a),
+            lambda a: np.where(a > 0, a, -a),
+        )
+        self.assert_func_equal(
+            (4, 5), ht.clip, np.clip,
+            heat_args={"min": -10.0, "max": 10.0},
+            numpy_args={"a_min": -10.0, "a_max": 10.0},
+        )
+
+    def test_logical_family(self):
+        self.assert_func_equal((4, 4), lambda a: ht.logical_not(a > 0), lambda a: ~(a > 0))
+        self.assert_func_equal(
+            (4, 4),
+            lambda a: ht.logical_and(a > 0, a < 100),
+            lambda a: (a > 0) & (a < 100),
+        )
+
+
+class TestRaggedMatrix(TestCase):
+    """The same sweeps at sizes indivisible by any mesh size 2..8."""
+
+    def test_reductions_prime_sizes(self):
+        self.assert_func_equal((7, 11), ht.sum, np.sum, rtol=1e-4, atol=1e-2)
+        self.assert_func_equal(
+            (11, 13), ht.mean, np.mean, heat_args={"axis": 0}, numpy_args={"axis": 0},
+            rtol=1e-4, atol=1e-3,
+        )
+        self.assert_func_equal(
+            (13, 7), ht.max, np.max, heat_args={"axis": 1}, numpy_args={"axis": 1}
+        )
+
+    def test_manipulations_prime_sizes(self):
+        self.assert_func_equal(
+            (11, 5),
+            lambda a, **k: ht.sort(a, **k)[0],
+            np.sort,
+            heat_args={"axis": 0},
+            numpy_args={"axis": 0},
+        )
+        self.assert_func_equal(
+            (7, 9), ht.cumsum, np.cumsum, heat_args={"axis": 0}, numpy_args={"axis": 0},
+            rtol=1e-4, atol=1e-2,
+        )
+        self.assert_func_equal((9, 7), ht.transpose, np.transpose)

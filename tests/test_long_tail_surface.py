@@ -1,0 +1,186 @@
+"""Behavioral coverage for the long-tail public surface.
+
+Every name here is exported but was previously untouched by any test:
+constants, random aliases, the sanitation helpers, the nn model zoo
+constructors, and the data utilities (reference parity surfaces from
+SURVEY.md §2.1-5/§2.1-11/§2.4-10/§2.4-12).
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from harness import TestCase
+
+
+class TestConstants(TestCase):
+    def test_values(self):
+        assert abs(ht.pi - np.pi) < 1e-15
+        assert abs(ht.PI - np.pi) < 1e-15
+        assert abs(ht.E - np.e) < 1e-15
+        assert ht.INF == float("inf") and ht.NINF == float("-inf")
+        assert ht.NAN != ht.NAN  # NaN compares unequal to itself
+        # usable directly in array math
+        assert float(ht.sin(ht.array(ht.pi / 2)).larray) == pytest.approx(1.0)
+
+
+class TestRandomAliases(TestCase):
+    def test_ranf_random_sample_in_unit_interval(self):
+        ht.random.seed(7)
+        for fn in (ht.random.ranf, ht.random.random_sample):
+            x = fn((20,))
+            v = np.asarray(x.larray)
+            assert v.shape == (20,) and (v >= 0).all() and (v < 1).all()
+
+    def test_random_integer_bounds(self):
+        ht.random.seed(8)
+        x = ht.random.random_integer(1, 6, (50,))
+        v = np.asarray(x.larray)
+        assert v.min() >= 1 and v.max() <= 6
+
+
+class TestSanitation(TestCase):
+    def test_sanitize_in_tensor_rejects_nonarray(self):
+        from heat_tpu.core import sanitation
+
+        with pytest.raises(TypeError):
+            sanitation.sanitize_in_tensor("not an array")
+
+    def test_sanitize_out_shape_mismatch(self):
+        from heat_tpu.core import sanitation
+
+        out = ht.zeros((3, 3))
+        with pytest.raises(ValueError):
+            sanitation.sanitize_out(out, (2, 2), out.split, out.device)
+
+    def test_sanitize_distribution_matches_split(self):
+        from heat_tpu.core import sanitation
+
+        target = ht.ones((8, 2), split=0)
+        other = ht.ones((8, 2), split=1)
+        fixed = sanitation.sanitize_distribution(other, target=target)
+        assert fixed.split == 0
+
+    def test_sanitize_lshape_and_sequence(self):
+        from heat_tpu.core import sanitation
+
+        arr = ht.ones((4, 2), split=0)
+        shard = np.zeros(arr.lshape, np.float32)
+        sanitation.sanitize_lshape(arr, shard)  # shard-shaped: must not raise
+        with pytest.raises(ValueError):
+            sanitation.sanitize_lshape(arr, np.zeros((99, 2), np.float32))
+        from heat_tpu.core.stride_tricks import sanitize_slice
+
+        assert sanitize_slice(slice(None), 5) == slice(0, 5, 1)
+        seq = sanitation.sanitize_sequence((1, 2, 3))
+        assert isinstance(seq, list)
+
+    def test_sanitize_infinity_and_memory_layout(self):
+        from heat_tpu.core import sanitation
+        from heat_tpu.core.memory import sanitize_memory_layout
+
+        assert sanitation.sanitize_infinity(ht.array([1.0, 2.0])) == float("inf")
+        assert sanitation.sanitize_infinity(ht.array([1, 2], dtype=ht.int32)) == np.iinfo(np.int32).max
+        x = ht.array([1.0, 2.0])
+        y = sanitize_memory_layout(x.larray, order="C")  # validated no-op
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x.larray))
+        with pytest.raises(ValueError):
+            sanitize_memory_layout(x.larray, order="K")
+
+
+class TestEstimatorMixins(TestCase):
+    def test_transform_mixin_detection(self):
+        from heat_tpu.core.base import TransformMixin, is_transformer
+
+        class Scaler(ht.BaseEstimator, TransformMixin):
+            def fit(self, x):
+                self.scale_ = float(ht.max(ht.abs(x)).item()) or 1.0
+                return self
+
+            def transform(self, x):
+                return x / self.scale_
+
+        s = Scaler().fit(ht.array([2.0, -4.0]))
+        assert is_transformer(s)
+        assert not is_transformer(object())
+        out = s.transform(ht.array([2.0]))
+        assert float(out.larray[0]) == pytest.approx(0.5)
+        # fit_transform comes from the mixin
+        out2 = Scaler().fit_transform(ht.array([2.0, -4.0]))
+        assert float(np.asarray(out2.larray).max()) <= 1.0
+
+
+class TestModelZoo(TestCase):
+    def test_resnet18_50_forward_shapes(self):
+        import jax
+
+        from heat_tpu.nn.models import ResNet18, ResNet50
+
+        x = np.zeros((2, 16, 16, 3), np.float32)
+        for ctor, blocks in ((ResNet18, "BasicBlock"), (ResNet50, "Bottleneck")):
+            model = ctor(num_classes=5)
+            var = model.init(jax.random.PRNGKey(0), x)
+            y = model.apply(var, x)
+            assert y.shape == (2, 5)
+
+    def test_block_types_compose(self):
+        import jax
+
+        from heat_tpu.nn.models import BasicBlock, Bottleneck
+
+        x = np.zeros((1, 8, 8, 16), np.float32)
+        for blk in (BasicBlock(filters=16), Bottleneck(filters=4)):
+            var = blk.init(jax.random.PRNGKey(0), x)
+            y = blk.apply(var, x)
+            assert y.shape[0] == 1 and y.ndim == 4
+
+    def test_simple_cnn(self):
+        import jax
+
+        from heat_tpu.nn.models import SimpleCNN
+
+        model = SimpleCNN(num_classes=4)
+        x = np.zeros((2, 12, 12, 1), np.float32)
+        var = model.init(jax.random.PRNGKey(0), x)
+        assert model.apply(var, x).shape == (2, 4)
+
+
+class TestDataUtilities(TestCase):
+    def test_make_mesh_axes(self):
+        import pytest as _pytest
+
+        from heat_tpu.parallel import make_mesh
+
+        p = self.comm.size
+        mesh = make_mesh([("dp", 1), ("tp", p)])
+        assert mesh.axis_names == ("dp", "tp") and mesh.devices.size == p
+        with _pytest.raises(ValueError):
+            make_mesh([("dp", p + 1)])
+
+    def test_dataset_shuffle_preserves_multiset(self):
+        from heat_tpu.utils.data import Dataset, dataset_shuffle
+
+        ht.random.seed(3)
+        data = ht.arange(24, split=0).reshape((12, 2))
+        ds = Dataset([data])
+        before = np.asarray(ds.arrays[0].larray).copy()
+        dataset_shuffle(ds)
+        after = np.asarray(ds.arrays[0].larray)
+        assert after.shape == before.shape
+        assert set(map(tuple, after.tolist())) == set(map(tuple, before.tolist()))
+
+    def test_mnist_dataset_contract(self):
+        # instantiating MNISTDataset downloads via torchvision (no network in
+        # CI) — pin the class contract instead: it IS a Dataset, so the
+        # DataLoader/shuffle machinery applies unchanged
+        from heat_tpu.utils.data import Dataset
+        from heat_tpu.utils.data.mnist import MNISTDataset
+
+        assert issubclass(MNISTDataset, Dataset)
+
+    def test_imagenet_converter_rejects_missing(self):
+        from heat_tpu.utils.data._utils import merge_files_imagenet_tfrecord
+
+        with pytest.raises((FileNotFoundError, OSError, ValueError, NotImplementedError)):
+            merge_files_imagenet_tfrecord("/nonexistent/path", "/tmp/out")

@@ -28,10 +28,11 @@ survives small f (f=16 padded in HBM would octuple the bytes).
 Like ops/pairwise.py, the jnp path stays the default until the kernel is
 measured faster on real hardware; today bench.py is the only consumer (the
 ``lloyd_fused_iters_per_sec`` field measures it side by side with the jnp
-path). Single-device only for now: the pallas_call has no partitioning
-spec, so a mesh-sharded operand would be gathered — the multi-chip path is
-a shard_map wrapper (per-device kernel + psum of sums/counts), not written
-yet.
+path). :func:`fused_lloyd_iter` is single-device (its pallas_call has no
+partitioning spec — ``fused_supported`` gates on that);
+:func:`fused_lloyd_iter_sharded` is the multi-chip form: a shard_map
+wrapper running the kernel per device and merging the (k, f) accumulators
+with one psum — the exact collective budget of the jnp path.
 """
 
 from __future__ import annotations
@@ -43,7 +44,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["fused_lloyd_iter", "fused_lloyd_run", "fused_supported"]
+__all__ = [
+    "fused_lloyd_iter",
+    "fused_lloyd_iter_sharded",
+    "fused_lloyd_run",
+    "fused_supported",
+]
 
 def _block_rows(f: int) -> int:
     """Rows per grid step, sized so one (BLOCK, f) f32 input block stays
@@ -67,18 +73,20 @@ def _lloyd_kernel(
     x_ref,
     csq_ref,
     cT_ref,
+    nvalid_ref,
     lab_ref,
     sums_ref,
     counts_ref,
     inertia_ref,
     *,
     k: int,
-    n_valid: int,
     block: int,
 ):
     """One (block, f) row block; accumulators live across the whole grid.
-    Rows at global index >= n_valid (tail-block padding) are masked out of
-    every accumulator."""
+    Rows at index >= nvalid (tail padding: ragged sizes, or a device's share
+    of the global padding under the sharded wrapper) are masked out of every
+    accumulator. n_valid is a runtime (1,1) scalar operand so each device
+    can carry its own count."""
     i = pl.program_id(0)
 
     xb = x_ref[:, :]  # (block, f)
@@ -92,7 +100,7 @@ def _lloyd_kernel(
     # 2-D iotas: Mosaic does not lower 1-D iota
     klane = jax.lax.broadcasted_iota(jnp.int32, (1, k), 1)
     rows = i * block + jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0)
-    valid = (rows < n_valid).astype(xb.dtype)  # (BLOCK, 1)
+    valid = (rows < nvalid_ref[0, 0]).astype(xb.dtype)  # (BLOCK, 1)
     onehot = (labels[:, None] == klane).astype(xb.dtype) * valid  # (BLOCK, k)
 
     @pl.when(i == 0)
@@ -109,6 +117,50 @@ def _lloyd_kernel(
     inertia_ref[:, :] += jnp.sum(masked_min, dtype=inertia_ref.dtype)[None, None]
 
 
+def _kernel_call(data, centers, k: int, n_valid, interpret: bool):
+    """Pad, tile, and invoke the kernel on one device's rows.
+
+    ``n_valid`` is a traced int32 scalar: rows at local index >= n_valid are
+    masked out of the accumulators (tail padding; under shard_map, each
+    device's share of the global pad). Returns the raw (labels2d, sums,
+    counts, inertia) outputs.
+    """
+    n, f = data.shape
+    csq = jnp.sum(centers * centers, axis=1, dtype=jnp.float32)[None, :]  # (1, k)
+    cT = centers.T.astype(data.dtype)  # (f, k)
+
+    x = data.astype(jnp.float32) if data.dtype == jnp.float64 else data
+    block = _block_rows(f)
+    n_pad = -(-n // block) * block
+    if n_pad != n:
+        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
+    nv = jnp.reshape(n_valid.astype(jnp.int32), (1, 1))
+
+    return pl.pallas_call(
+        functools.partial(_lloyd_kernel, k=k, block=block),
+        out_shape=(
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((k, f), jnp.float32),
+            jax.ShapeDtypeStruct((1, k), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ),
+        grid=(n_pad // block,),
+        in_specs=[
+            pl.BlockSpec((block, f), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((f, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((block, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, f), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(x, csq, cT, nv)
+
+
 @functools.partial(jax.jit, static_argnames=("k", "interpret"))
 def fused_lloyd_iter(
     data: jax.Array, centers: jax.Array, k: int, xsq_sum=None, interpret: bool = False
@@ -121,49 +173,26 @@ def fused_lloyd_iter(
     iteration loop, or it is computed here (costing the one extra data read
     the kernel exists to avoid).
     """
-    n, f = data.shape
-    csq = jnp.sum(centers * centers, axis=1, dtype=jnp.float32)[None, :]  # (1, k)
-    cT = centers.T.astype(data.dtype)  # (f, k)
+    n = data.shape[0]
+    labels2d, sums, counts, inertia = _kernel_call(
+        data, centers, k, jnp.asarray(n, jnp.int32), interpret
+    )
+    if xsq_sum is None:
+        x32 = data.astype(jnp.float32)
+        xsq_sum = jnp.sum(x32 * x32)
+    return _finalize(labels2d[:n, 0], sums, counts, inertia, centers, xsq_sum)
 
-    x = data.astype(jnp.float32) if data.dtype == jnp.float64 else data
-    block = _block_rows(f)
-    n_pad = -(-n // block) * block
-    if n_pad != n:
-        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
 
-    labels2d, sums, counts, inertia = pl.pallas_call(
-        functools.partial(_lloyd_kernel, k=k, n_valid=n, block=block),
-        out_shape=(
-            jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
-            jax.ShapeDtypeStruct((k, f), jnp.float32),
-            jax.ShapeDtypeStruct((1, k), jnp.float32),
-            jax.ShapeDtypeStruct((1, 1), jnp.float32),
-        ),
-        grid=(n_pad // block,),
-        in_specs=[
-            pl.BlockSpec((block, f), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((f, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
-        ],
-        out_specs=(
-            pl.BlockSpec((block, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((k, f), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
-        ),
-        interpret=interpret,
-    )(x, csq, cT)
-
+def _finalize(labels, sums, counts, inertia, centers, xsq_sum):
+    """Shared epilogue: centroid update (empty clusters keep their center),
+    inertia restoration (+Σ|x|²), and the convergence shift. One body for
+    the single-device and sharded paths so their numerics cannot drift."""
     counts = counts[0]
-    labels = labels2d[:n, 0]
     new_centers = jnp.where(
         counts[:, None] > 0,
         sums / jnp.maximum(counts[:, None], 1.0),
         centers.astype(jnp.float32),
     ).astype(centers.dtype)
-    if xsq_sum is None:
-        x32 = data.astype(jnp.float32)
-        xsq_sum = jnp.sum(x32 * x32)
     inertia_full = jnp.maximum(inertia[0, 0] + xsq_sum, 0.0)
     shift = jnp.sum((new_centers - centers).astype(jnp.float32) ** 2)
     return new_centers, labels, inertia_full, shift
@@ -186,3 +215,65 @@ def fused_lloyd_run(
     return jax.lax.fori_loop(
         0, n_steps, body, (centers, jnp.zeros(data.shape[0], jnp.int32), acc, acc)
     )
+
+
+def fused_lloyd_iter_sharded(
+    data: jax.Array,
+    centers: jax.Array,
+    k: int,
+    comm,
+    n_global: int,
+    xsq_sum=None,
+    interpret: bool = False,
+):
+    """One fused Lloyd iteration over a row-sharded operand.
+
+    ``data`` is the PHYSICAL payload (``DNDarray.parray``): row count a
+    multiple of the mesh size, suffix-padded when the logical ``n_global``
+    is ragged. Each device runs the single-pass kernel on its own block —
+    masking its share of the global padding — and the (k, f)/(k,)/scalar
+    accumulators merge with one ``psum``. Labels come back sliced to the
+    logical length ``n_global``.
+
+    Same return contract as :func:`fused_lloyd_iter`. The whole iteration
+    (shard_map + epilogue) is jitted, cached per (mesh, k, shapes).
+    """
+    fn = _sharded_fn(comm.mesh, comm.axis_name, comm.size, k, int(n_global), bool(interpret))
+    return fn(data, centers, xsq_sum)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_fn(mesh, axis, p, k, n_global, interpret):
+    """Jitted sharded iteration, cached per static config (the
+    attention.py:_ring_attention_fn closure-cache pattern — comm objects are
+    unhashable, their mesh/axis are)."""
+    from jax.sharding import PartitionSpec as P
+
+    def device_step(xl, c):
+        local_rows = xl.shape[0]
+        idx = jax.lax.axis_index(axis)
+        local_valid = jnp.clip(n_global - idx * local_rows, 0, local_rows)
+        labels2d, sums, counts, inertia = _kernel_call(xl, c, k, local_valid, interpret)
+        sums = jax.lax.psum(sums, axis)
+        counts = jax.lax.psum(counts, axis)
+        inertia = jax.lax.psum(inertia, axis)
+        return labels2d[:local_rows], sums, counts, inertia
+
+    @jax.jit
+    def run(data, centers, xsq_sum):
+        labels2d, sums, counts, inertia = jax.shard_map(
+            device_step,
+            mesh=mesh,
+            in_specs=(P(axis, None), P()),
+            out_specs=(P(axis, None), P(), P(), P()),
+            check_vma=False,  # pallas_call outputs carry no vma annotation
+        )(data, centers)
+        if xsq_sum is None:
+            # Σ|x|² over the LOGICAL rows only: the physical pad region's
+            # content is unspecified (dndarray.parray contract) — never
+            # fold it into the inertia
+            x32 = data[:n_global].astype(jnp.float32)
+            xsq_sum = jnp.sum(x32 * x32)
+        return _finalize(labels2d[:n_global, 0], sums, counts, inertia, centers, xsq_sum)
+
+    return run

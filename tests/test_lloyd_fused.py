@@ -73,3 +73,48 @@ class TestFusedLloyd(TestCase):
         new_c, labels, _, _ = fused_lloyd_iter(data, centers, 2, interpret=True)
         assert (np.asarray(labels) == 0).all()
         np.testing.assert_array_equal(np.asarray(new_c)[1], centers[1])  # empty keeps old
+
+    def test_sharded_wrapper_matches_reference(self):
+        import jax
+        import jax.numpy as jnp
+
+        import heat_tpu as ht
+        from heat_tpu.cluster.kmeans import _lloyd_iter
+        from heat_tpu.ops.lloyd import fused_lloyd_iter_sharded
+
+        comm = ht.get_comm()
+        rng = np.random.default_rng(7)
+        n, f, k = 4 * comm.size + 3, 6, 4  # ragged: physical pad on last device
+        data_np = rng.standard_normal((n, f)).astype(np.float32)
+        centers = jnp.asarray(rng.standard_normal((k, f)).astype(np.float32) * 2)
+
+        x = ht.array(data_np, split=0)  # physical payload padded to p blocks
+        got_c, got_lab, got_inertia, got_shift = fused_lloyd_iter_sharded(
+            x.parray, centers, k, comm, n_global=n, interpret=True
+        )
+        ref_c, ref_lab, ref_inertia, ref_shift = jax.jit(
+            _lloyd_iter, static_argnames="k"
+        )(jnp.asarray(data_np), centers, k)
+
+        np.testing.assert_array_equal(np.asarray(got_lab)[:n], np.asarray(ref_lab))
+        np.testing.assert_allclose(np.asarray(got_c), np.asarray(ref_c), rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(float(got_inertia), float(ref_inertia), rtol=1e-4)
+        np.testing.assert_allclose(float(got_shift), float(ref_shift), rtol=1e-4, atol=1e-6)
+
+    def test_sharded_wrapper_divisible(self):
+        import jax.numpy as jnp
+
+        import heat_tpu as ht
+        from heat_tpu.cluster.kmeans import _lloyd_iter
+        from heat_tpu.ops.lloyd import fused_lloyd_iter_sharded
+
+        comm = ht.get_comm()
+        rng = np.random.default_rng(8)
+        n, f, k = 8 * comm.size, 5, 3
+        data_np = rng.standard_normal((n, f)).astype(np.float32)
+        centers = jnp.asarray(rng.standard_normal((k, f)).astype(np.float32))
+        x = ht.array(data_np, split=0)
+        got = fused_lloyd_iter_sharded(x.parray, centers, k, comm, n_global=n, interpret=True)
+        ref = _lloyd_iter(jnp.asarray(data_np), centers, k)
+        np.testing.assert_allclose(np.asarray(got[0]), np.asarray(ref[0]), rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(ref[1]))

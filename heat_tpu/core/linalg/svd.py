@@ -27,7 +27,7 @@ from . import basics
 from .qr import qr
 from .solver import solve_triangular
 
-__all__ = ["svd", "lstsq"]
+__all__ = ["svd", "lstsq", "pinv"]
 
 SVD = collections.namedtuple("SVD", "U, S, Vh")
 
@@ -99,3 +99,23 @@ def lstsq(a: DNDarray, b: DNDarray, rcond: Optional[float] = None) -> DNDarray:
     if squeeze:
         x = x.reshape((n,))
     return x
+
+
+def pinv(a: DNDarray, rcond: float = 1e-15) -> DNDarray:
+    """Moore–Penrose pseudo-inverse via :func:`svd`.
+
+    Singular values below ``rcond * max(S)`` are zeroed in the reciprocal
+    (numpy's contract). The result has the transpose's natural split: a
+    split-0 tall operand yields a split-1 ``(n, m)`` pseudo-inverse.
+    """
+    sanitation.sanitize_in(a)
+    if a.ndim != 2:
+        raise ValueError(f"pinv requires a 2-D operand, got {a.ndim}-D")
+    u, s, vh = svd(a)
+    s_np = s.larray
+    cutoff = rcond * jnp.max(s_np)
+    s_inv = jnp.where(s_np > cutoff, 1.0 / jnp.where(s_np > cutoff, s_np, 1.0), 0.0)
+    s_inv_arr = factories.array(s_inv, device=a.device, comm=a.comm)
+    # A⁺ = V S⁺ Uᵀ — scale Vh's rows, then one sharding-preserving matmul
+    v_scaled = basics.transpose(vh) * s_inv_arr  # (n, r) * (r,) broadcast
+    return basics.matmul(v_scaled, basics.transpose(u))

@@ -1,0 +1,121 @@
+"""Second statistics depth sweep: weighted average, cov variants, histogram
+bins/range, digitize/bucketize boundaries, median axes — against numpy, with
+split sweeps (reference test_statistics.py patterns)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from harness import TestCase
+
+
+class TestAverageDepth(TestCase):
+    def test_weighted_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        x_np = rng.standard_normal((8, 5)).astype(np.float32)
+        w_np = rng.uniform(0.1, 2.0, 5).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.resplit(ht.array(x_np), split)
+            got = ht.average(x, axis=1, weights=ht.array(w_np))
+            np.testing.assert_allclose(
+                np.asarray(got.larray), np.average(x_np, axis=1, weights=w_np), rtol=1e-5
+            )
+
+    def test_returned_weight_sum(self):
+        rng = np.random.default_rng(1)
+        x_np = rng.standard_normal((6, 4)).astype(np.float32)
+        w_np = rng.uniform(0.1, 1.0, 6).astype(np.float32)
+        x = ht.array(x_np, split=0)
+        avg, wsum = ht.average(x, axis=0, weights=ht.array(w_np, split=0), returned=True)
+        e_avg, e_wsum = np.average(x_np, axis=0, weights=w_np, returned=True)
+        np.testing.assert_allclose(np.asarray(avg.larray), e_avg, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(wsum.larray), e_wsum, rtol=1e-5)
+
+    def test_flat_average(self):
+        x_np = np.arange(10, dtype=np.float32)
+        got = ht.average(ht.array(x_np, split=0))
+        assert float(got.larray) == pytest.approx(4.5)
+
+
+class TestCovDepth(TestCase):
+    def test_rowvar_bias_ddof(self):
+        rng = np.random.default_rng(2)
+        m_np = rng.standard_normal((4, 30)).astype(np.float32)
+        for split in (None, 0, 1):
+            m = ht.resplit(ht.array(m_np), split)
+            for kwargs in ({}, {"bias": True}, {"ddof": 0}, {"rowvar": False}):
+                got = ht.cov(m, **kwargs)
+                np.testing.assert_allclose(
+                    np.asarray(got.larray), np.cov(m_np, **kwargs), rtol=1e-4, atol=1e-5
+                )
+
+    def test_two_operand(self):
+        rng = np.random.default_rng(3)
+        a_np = rng.standard_normal(25).astype(np.float32)
+        b_np = rng.standard_normal(25).astype(np.float32)
+        got = ht.cov(ht.array(a_np, split=0), ht.array(b_np, split=0))
+        np.testing.assert_allclose(np.asarray(got.larray), np.cov(a_np, b_np), rtol=1e-4)
+
+
+class TestHistogramDepth(TestCase):
+    def test_bins_and_range(self):
+        rng = np.random.default_rng(4)
+        x_np = rng.uniform(-3, 3, 200).astype(np.float32)
+        for split in (None, 0):
+            x = ht.resplit(ht.array(x_np), split)
+            for bins, rng_ in ((10, None), (7, (-2.0, 2.0)), (16, (-4.0, 4.0))):
+                got_h, got_e = ht.histogram(x, bins=bins, range=rng_)
+                exp_h, exp_e = np.histogram(x_np, bins=bins, range=rng_)
+                np.testing.assert_array_equal(np.asarray(got_h.larray), exp_h)
+                np.testing.assert_allclose(np.asarray(got_e.larray), exp_e, rtol=1e-5)
+
+    def test_density(self):
+        rng = np.random.default_rng(5)
+        x_np = rng.standard_normal(150).astype(np.float32)
+        got_h, _ = ht.histogram(ht.array(x_np, split=0), bins=8, density=True)
+        exp_h, _ = np.histogram(x_np, bins=8, density=True)
+        np.testing.assert_allclose(np.asarray(got_h.larray), exp_h, rtol=1e-4)
+
+
+class TestDigitizeBucketize(TestCase):
+    def test_boundary_right_flag(self):
+        bins = np.array([0.0, 1.0, 2.0, 3.0], np.float32)
+        x_np = np.array([-0.5, 0.0, 0.5, 1.0, 2.999, 3.0, 3.5], np.float32)
+        for split in (None, 0):
+            x = ht.resplit(ht.array(x_np), split)
+            for right in (False, True):
+                got = ht.digitize(x, ht.array(bins), right=right)
+                np.testing.assert_array_equal(
+                    np.asarray(got.larray), np.digitize(x_np, bins, right=right)
+                )
+
+    def test_bucketize_torch_contract(self):
+        import torch
+
+        bins = np.array([1.0, 3.0, 5.0], np.float32)
+        x_np = np.array([0.0, 1.0, 2.0, 3.0, 4.0, 5.0, 6.0], np.float32)
+        for right in (False, True):
+            got = ht.bucketize(ht.array(x_np, split=0), ht.array(bins), right=right)
+            expected = torch.bucketize(torch.tensor(x_np), torch.tensor(bins), right=right)
+            np.testing.assert_array_equal(np.asarray(got.larray), expected.numpy())
+
+
+class TestMedianDepth(TestCase):
+    def test_axis_and_keepdims(self):
+        rng = np.random.default_rng(6)
+        x_np = rng.standard_normal((6, 9)).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.resplit(ht.array(x_np), split)
+            for axis in (None, 0, 1):
+                got = ht.median(x, axis=axis)
+                np.testing.assert_allclose(
+                    np.asarray(got.larray), np.median(x_np, axis=axis), rtol=1e-5, atol=1e-6
+                )
+            got_k = ht.median(x, axis=1, keepdims=True)
+            assert tuple(got_k.shape) == (6, 1)
+
+    def test_even_length_interpolates(self):
+        x_np = np.array([1.0, 3.0, 2.0, 4.0], np.float32)
+        got = ht.median(ht.array(x_np, split=0))
+        assert float(got.larray) == pytest.approx(2.5)

@@ -96,3 +96,31 @@ class TestLstsq(TestCase):
             ht.linalg.lstsq(ht.ones((5, 2)), ht.ones(4))  # mismatched b
         with pytest.raises(NotImplementedError):
             ht.linalg.lstsq(ht.ones((5, 2)), ht.ones(5), rcond=1e-6)
+
+class TestPinv(TestCase):
+    def test_matches_numpy_tall_wide(self):
+        rng = np.random.default_rng(10)
+        for shape in ((12, 4), (4, 12), (6, 6)):
+            a_np = rng.standard_normal(shape).astype(np.float32)
+            for split in (None, 0, 1):
+                a = ht.resplit(ht.array(a_np), split)
+                got = ht.linalg.pinv(a)
+                np.testing.assert_allclose(
+                    np.asarray(got.larray), np.linalg.pinv(a_np), rtol=1e-3, atol=1e-4
+                )
+
+    def test_rank_deficient_cutoff(self):
+        rng = np.random.default_rng(11)
+        base = rng.standard_normal((10, 2)).astype(np.float32)
+        a_np = np.concatenate([base, base[:, :1] + base[:, 1:]], axis=1)  # rank 2 of 3
+        got = ht.linalg.pinv(ht.array(a_np, split=0), rcond=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(got.larray), np.linalg.pinv(a_np, rcond=1e-5), rtol=1e-2, atol=1e-3
+        )
+        # Moore-Penrose property: A A+ A = A
+        rec = a_np @ np.asarray(got.larray) @ a_np
+        np.testing.assert_allclose(rec, a_np, rtol=1e-3, atol=1e-3)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ht.linalg.pinv(ht.ones((2, 2, 2)))

@@ -11,5 +11,8 @@ extension: str = "dev"
 
 if not extension:
     __version__ = f"{major}.{minor}.{micro}"
+    __pep440__ = __version__
 else:
     __version__ = f"{major}.{minor}.{micro}-{extension}"
+    # packaging needs a PEP 440 rendering ("-dev" is not one)
+    __pep440__ = f"{major}.{minor}.{micro}.{extension}0"

@@ -46,6 +46,7 @@ __all__ = [
     "Communication",
     "MeshCommunication",
     "get_comm",
+    "initialize",
     "sanitize_comm",
     "use_comm",
 ]
@@ -415,6 +416,65 @@ class MeshCommunication(Communication):
     def __repr__(self) -> str:
         plat = self._devices[0].platform if self._devices else "?"
         return f"MeshCommunication({self.size} {plat} device(s), axis={self.axis_name!r})"
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    **kwargs,
+) -> MeshCommunication:
+    """Multi-host bring-up: connect this controller to the cluster and make
+    the default communication context span every device in it.
+
+    The reference framework inherits its world from ``mpirun`` (MPI_WORLD,
+    reference communication.py:1886-1891); the single-controller analog is
+    ``jax.distributed.initialize`` — one Python process per host, all hosts'
+    devices visible globally afterwards. On TPU pods the coordinator is
+    auto-detected, so ``initialize()`` with no arguments suffices; elsewhere
+    pass ``coordinator_address``/``num_processes``/``process_id``.
+
+    Idempotent: re-initialization errors from an already-connected runtime
+    are swallowed. Returns the refreshed default comm (and installs it via
+    :func:`use_comm`).
+    """
+    global MESH_WORLD, MESH_SELF, __default_comm
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        )
+    except (RuntimeError, ValueError) as exc:
+        msg = str(exc).lower()
+        # cluster launchers advertise the world size in the environment; if
+        # one says we are multi-process, a failed bring-up must surface
+        hinted_world = max(
+            int(os.environ.get("SLURM_NTASKS", "1") or 1),
+            int(os.environ.get("OMPI_COMM_WORLD_SIZE", "1") or 1),
+            int(os.environ.get("PMI_SIZE", "1") or 1),
+        )
+        single = (num_processes is None or num_processes == 1) and hinted_world == 1
+        if ("already" in msg or "once" in msg) and "in use" not in msg:
+            pass  # connected earlier: keep the live service (idempotent)
+        elif single and ("must be called before" in msg or "coordinator_address" in msg):
+            # backend already up, or no cluster to auto-detect, in a genuinely
+            # single-process world: the service adds nothing — refreshing the
+            # default comm is all that's needed
+            import warnings
+
+            warnings.warn(
+                f"heat_tpu.initialize(): no cluster to join ({exc}); "
+                "continuing as a single-host world",
+                stacklevel=2,
+            )
+        else:
+            raise
+    MESH_WORLD = MeshCommunication()
+    MESH_SELF = MeshCommunication(jax.devices()[:1])
+    __default_comm = MESH_WORLD
+    return MESH_WORLD
 
 
 def _world() -> MeshCommunication:

@@ -1,0 +1,148 @@
+"""Numeric convention pins: the places NumPy/torch/C disagree.
+
+The reference inherits torch's conventions (fmod truncates toward zero,
+remainder follows the divisor's sign, round half-to-even, …); the oracle
+below is numpy/torch explicitly per case, so a backend swap can never
+silently flip a sign convention. Mixed-sign operands throughout.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from harness import TestCase
+
+MIXED = np.array([7.0, -7.0, 7.5, -7.5, 0.0, 2.5, -2.5], np.float32)
+DIV = np.array([3.0, 3.0, -3.0, -3.0, 3.0, -2.0, 2.0], np.float32)
+
+
+class TestModFamily(TestCase):
+    def test_mod_follows_divisor_sign(self):
+        # ht.mod == numpy remainder semantics (result has divisor's sign);
+        # assert_array_equal also pins the physical shard layout (pad+mask)
+        for split in (None, 0):
+            a = ht.resplit(ht.array(MIXED), split)
+            b = ht.resplit(ht.array(DIV), split)
+            self.assert_array_equal(ht.mod(a, b), np.mod(MIXED, DIV), rtol=1e-6)
+
+    def test_fmod_truncates_toward_zero(self):
+        # ht.fmod == C fmod semantics (result has dividend's sign)
+        for split in (None, 0):
+            a = ht.resplit(ht.array(MIXED), split)
+            b = ht.resplit(ht.array(DIV), split)
+            self.assert_array_equal(ht.fmod(a, b), np.fmod(MIXED, DIV), rtol=1e-6)
+
+    def test_remainder_is_mod_alias(self):
+        a = ht.array(MIXED, split=0)
+        b = ht.array(DIV, split=0)
+        np.testing.assert_array_equal(
+            np.asarray(ht.remainder(a, b).larray), np.asarray(ht.mod(a, b).larray)
+        )
+
+    def test_floordiv_floors(self):
+        for split in (None, 0):
+            a = ht.resplit(ht.array(MIXED), split)
+            b = ht.resplit(ht.array(DIV), split)
+            got = np.asarray(ht.floordiv(a, b).larray)
+            np.testing.assert_allclose(got, np.floor_divide(MIXED, DIV), rtol=1e-6)
+
+    def test_integer_mod_negative(self):
+        a_np = np.array([7, -7, 5, -5], np.int32)
+        b_np = np.array([3, 3, -3, -3], np.int32)
+        got = np.asarray(ht.mod(ht.array(a_np, split=0), ht.array(b_np, split=0)).larray)
+        np.testing.assert_array_equal(got, np.mod(a_np, b_np))
+
+
+class TestRoundingConventions(TestCase):
+    def test_round_half_to_even(self):
+        x_np = np.array([0.5, 1.5, 2.5, -0.5, -1.5, -2.5, 3.5], np.float32)
+        got = np.asarray(ht.round(ht.array(x_np, split=0)).larray)
+        np.testing.assert_array_equal(got, np.round(x_np))  # banker's rounding
+
+    def test_floor_ceil_trunc_negative(self):
+        x_np = np.array([1.7, -1.7, 2.0, -2.0, 0.3, -0.3], np.float32)
+        for split in (None, 0):
+            x = ht.resplit(ht.array(x_np), split)
+            np.testing.assert_array_equal(np.asarray(ht.floor(x).larray), np.floor(x_np))
+            np.testing.assert_array_equal(np.asarray(ht.ceil(x).larray), np.ceil(x_np))
+            np.testing.assert_array_equal(np.asarray(ht.trunc(x).larray), np.trunc(x_np))
+
+    def test_modf_signs(self):
+        x_np = np.array([2.75, -2.75, 0.5, -0.5], np.float32)
+        frac, whole = ht.modf(ht.array(x_np, split=0))
+        e_frac, e_whole = np.modf(x_np)
+        np.testing.assert_allclose(np.asarray(frac.larray), e_frac, rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(whole.larray), e_whole)
+
+    def test_sign_and_sgn_zero(self):
+        x_np = np.array([3.0, -3.0, 0.0, -0.0], np.float32)
+        got = np.asarray(ht.sign(ht.array(x_np, split=0)).larray)
+        np.testing.assert_array_equal(got, np.sign(x_np))
+        got_sgn = np.asarray(ht.sgn(ht.array(x_np, split=0)).larray)
+        np.testing.assert_array_equal(got_sgn, np.sign(x_np))
+        # the two differ on complex: sign uses the real part's sign, sgn is z/|z|
+        z_np = np.array([3 + 4j, 0 + 0j], np.complex64)
+        got_c = np.asarray(ht.sgn(ht.array(z_np, split=0)).larray)
+        np.testing.assert_allclose(got_c, np.array([0.6 + 0.8j, 0]), rtol=1e-6)
+
+
+class TestNaNSemantics(TestCase):
+    def test_comparisons_with_nan_are_false(self):
+        x_np = np.array([1.0, np.nan, 3.0], np.float32)
+        x = ht.array(x_np, split=0)
+        for op in ("eq", "lt", "gt", "le", "ge"):
+            got = np.asarray(getattr(ht, op)(x, x).larray)
+            expected = getattr(np, {"eq": "equal", "lt": "less", "gt": "greater",
+                                    "le": "less_equal", "ge": "greater_equal"}[op])(x_np, x_np)
+            np.testing.assert_array_equal(got, expected)
+        # ne is the complement: NaN != NaN is True
+        np.testing.assert_array_equal(
+            np.asarray(ht.ne(x, x).larray), np.not_equal(x_np, x_np)
+        )
+
+    def test_minmax_propagate_vs_reduce(self):
+        x_np = np.array([1.0, np.nan, 3.0], np.float32)
+        x = ht.array(x_np, split=0)
+        # elementwise maximum/minimum propagate NaN like numpy
+        other = ht.full_like(x, 2.0)
+        np.testing.assert_array_equal(
+            np.asarray(ht.maximum(x, other).larray), np.maximum(x_np, 2.0)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ht.minimum(x, other).larray), np.minimum(x_np, 2.0)
+        )
+        # reductions also propagate (numpy max semantics, not nanmax)
+        assert np.isnan(float(ht.max(x).item()))
+        assert np.isnan(float(ht.min(x).item()))
+
+    def test_isnan_isinf_isfinite_partition(self):
+        x_np = np.array([1.0, np.nan, np.inf, -np.inf, 0.0], np.float32)
+        for split in (None, 0):
+            x = ht.resplit(ht.array(x_np), split)
+            np.testing.assert_array_equal(np.asarray(ht.isnan(x).larray), np.isnan(x_np))
+            np.testing.assert_array_equal(np.asarray(ht.isinf(x).larray), np.isinf(x_np))
+            np.testing.assert_array_equal(np.asarray(ht.isfinite(x).larray), np.isfinite(x_np))
+
+    def test_allclose_nan_handling(self):
+        a = ht.array([1.0, np.nan], split=0)
+        assert not bool(ht.allclose(a, a))
+        assert bool(ht.allclose(a, a, equal_nan=True))
+
+
+class TestDivisionEdges(TestCase):
+    def test_float_division_by_zero(self):
+        a = ht.array([1.0, -1.0, 0.0], split=0)
+        b = ht.zeros(3, split=0)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            expected = np.array([1.0, -1.0, 0.0], np.float32) / np.zeros(3, np.float32)
+        got = np.asarray((a / b).larray)
+        np.testing.assert_array_equal(np.isnan(got), np.isnan(expected))
+        np.testing.assert_array_equal(got[~np.isnan(expected)], expected[~np.isnan(expected)])
+
+    def test_power_conventions(self):
+        # 0**0 == 1, negative base with integer exponent
+        a_np = np.array([0.0, -2.0, -2.0, 4.0], np.float32)
+        e_np = np.array([0.0, 2.0, 3.0, 0.5], np.float32)
+        got = np.asarray(ht.pow(ht.array(a_np, split=0), ht.array(e_np, split=0)).larray)
+        np.testing.assert_allclose(got, np.power(a_np, e_np), rtol=1e-6)

@@ -236,6 +236,21 @@ def worker() -> None:
     except Exception:  # noqa: BLE001 - diagnostics must never cost the record
         pass
 
+    # CholeskyQR2 (all-matmul tall-skinny QR, MXU-native) vs the Householder
+    # TSQR the headline qr_tflops uses — measured side by side
+    try:
+        qq2, qr2 = ht.linalg.qr(qa, method="cholqr2")
+        float(qr2.larray[0, 0])  # compile + sync
+        cq_best = float("inf")
+        for _ in range(2):
+            start = time.perf_counter()
+            qq2, qr2 = ht.linalg.qr(qa, method="cholqr2")
+            float(qr2.larray[0, 0])
+            cq_best = min(cq_best, time.perf_counter() - start)
+        record["qr_cholqr2_tflops"] = round(2.0 * qr_m * QR_N * QR_N / cq_best / 1e12, 3)
+    except Exception:  # noqa: BLE001 - diagnostics must never cost the record
+        pass
+
     # fused pallas Lloyd kernel (ops/lloyd.py): single data pass per
     # iteration vs the jnp path's two contraction reads — measured side by
     # side; the headline stays on the default path until this wins on HW

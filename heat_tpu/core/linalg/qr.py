@@ -52,15 +52,27 @@ def qr(
     tiles_per_proc: int = 1,
     calc_q: bool = True,
     overwrite_a: bool = False,
+    method: str = "tsqr",
 ) -> QR:
     """Reduced QR decomposition of a 2-D DNDarray (reference qr.py:17-179).
 
     ``tiles_per_proc``/``overwrite_a`` are accepted for API parity; the TSQR /
     panel schedules have no tile-count knob and never mutate their input.
+
+    ``method``: ``"tsqr"`` (default — Householder-based, unconditionally
+    stable) or ``"cholqr2"`` — CholeskyQR2 for tall-skinny operands: R from
+    ``chol(AᵀA)``, Q by triangular solve, repeated once for re-orthonormal-
+    ization. Every FLOP is a matmul, so on TPU it runs on the MXU where
+    Householder QR is mostly vector work; the price is a squared condition
+    number in the first pass — safe for ``cond(A) ≲ 1/√ε`` (~3e3 f32 /
+    ~7e7 f64), and it raises on detected breakdown (non-finite Cholesky)
+    rather than returning garbage.
     """
     sanitation.sanitize_in(a)
     if a.ndim != 2:
         raise ValueError(f"qr requires a 2-D array, got {a.ndim}-D")
+    if method not in ("tsqr", "cholqr2"):
+        raise ValueError(f"unknown qr method {method!r}: expected 'tsqr' or 'cholqr2'")
     if not types.heat_type_is_inexact(a.dtype):
         a = a.astype(types.promote_types(a.dtype, types.float32))
 
@@ -70,10 +82,20 @@ def qr(
 
     q_split = a.split
     r_split: Optional[int] = None
+    if method == "cholqr2":
+        if m < n:
+            raise ValueError(f"cholqr2 requires a tall operand (m >= n), got {a.shape}")
+        q_arr, r_arr = _cholqr2_kernel(a.larray, calc_q)
+        if not bool(jnp.isfinite(r_arr).all()):
+            raise ValueError(
+                "cholqr2 broke down (Cholesky of the Gram matrix is not finite): "
+                "the operand is rank-deficient or too ill-conditioned for the "
+                "squared-condition first pass — use method='tsqr'"
+            )
     # TSQR needs a full (n, n) R per block: block = ceil(m/p) >= n, otherwise
     # the R-tile all-gather would move p*block*n = the FULL operand volume —
     # exactly the silent gather the explicit fallback policy exists to avoid
-    if a.split == 0 and p > 1 and m >= n and -(-m // p) >= n:
+    elif a.split == 0 and p > 1 and m >= n and -(-m // p) >= n:
         q_arr, r_arr = _tsqr(a, comm)
     elif a.split == 1 and p > 1 and m >= n:
         q_arr, r_arr = _panel_qr_split1(a, comm)
@@ -92,14 +114,6 @@ def qr(
         q_arr, r_arr = jnp.linalg.qr(a.larray, mode="reduced")
         r_split = 1 if a.split == 1 else None
 
-    q = DNDarray(
-        _ensure_split(q_arr, q_split, comm),
-        tuple(q_arr.shape),
-        types.canonical_heat_type(q_arr.dtype),
-        q_split,
-        a.device,
-        comm,
-    )
     r = DNDarray(
         _ensure_split(r_arr, r_split, comm),
         tuple(r_arr.shape),
@@ -108,8 +122,16 @@ def qr(
         a.device,
         comm,
     )
-    if not calc_q:
+    if not calc_q or q_arr is None:
         return QR(None, r)
+    q = DNDarray(
+        _ensure_split(q_arr, q_split, comm),
+        tuple(q_arr.shape),
+        types.canonical_heat_type(q_arr.dtype),
+        q_split,
+        a.device,
+        comm,
+    )
     return QR(q, r)
 
 
@@ -234,3 +256,26 @@ def _panel_qr_split1(a: DNDarray, comm) -> Tuple[jax.Array, jax.Array]:
         q_pad = q_pad[:, :n]
         r_pad = r_pad[:n, :n]
     return q_pad, r_pad
+
+
+@functools.partial(jax.jit, static_argnames=("calc_q",))
+def _cholqr2_kernel(x, calc_q: bool = True):
+    """Two CholeskyQR passes, one XLA program. Everything is a matmul or a
+    small (n, n) factorization, so the m-dimensional work runs on the MXU and
+    GSPMD turns the Gram contractions into psums over the split axis.
+    Hermitian Gram (``xᴴx``) so complex operands factor correctly. With
+    ``calc_q=False`` the second (largest) triangular solve is skipped — R
+    only needs the second pass's Cholesky factor."""
+
+    def gram_chol(x):
+        g = jnp.conjugate(x).mT @ x  # (n, n) — psum over the sharded rows
+        return jnp.conjugate(jnp.linalg.cholesky(g)).mT  # upper factor
+
+    def solve(r, x):
+        return jax.lax.linalg.triangular_solve(r, x, left_side=False, lower=False)
+
+    r1 = gram_chol(x)
+    q1 = solve(r1, x)
+    r2 = gram_chol(q1)  # re-orthonormalization pass
+    q2 = solve(r2, q1) if calc_q else None
+    return q2, r2 @ r1

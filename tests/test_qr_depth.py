@@ -7,6 +7,7 @@ import re
 import warnings
 
 import numpy as np
+import pytest
 
 import heat_tpu as ht
 
@@ -173,3 +174,60 @@ class TestQRGuards(TestCase):
         finally:
             qr_mod._REPLICATED_MAX_ELEMENTS = old
         np.testing.assert_allclose(Q.numpy() @ R.numpy(), a.numpy(), atol=1e-10)
+
+
+class TestCholQR2(TestCase):
+    """CholeskyQR2: the MXU-native tall-skinny method (opt-in)."""
+
+    def test_orthonormal_and_reconstructs(self):
+        rng = np.random.default_rng(20)
+        for shape in ((64, 6), (37, 5)):  # divisible and ragged rows
+            a_np = rng.standard_normal(shape).astype(np.float32)
+            for split in (None, 0):
+                a = ht.resplit(ht.array(a_np), split)
+                q, r = ht.linalg.qr(a, method="cholqr2")
+                q_np = np.asarray(q.larray)
+                r_np = np.asarray(r.larray)
+                np.testing.assert_allclose(q_np.T @ q_np, np.eye(shape[1]), atol=2e-4)
+                np.testing.assert_allclose(q_np @ r_np, a_np, atol=2e-4)
+                assert np.allclose(r_np, np.triu(r_np))  # upper triangular
+                if split == 0:
+                    assert q.split == 0 and r.split is None
+
+    def test_r_matches_tsqr_up_to_sign(self):
+        rng = np.random.default_rng(21)
+        a_np = rng.standard_normal((48, 4)).astype(np.float32)
+        a = ht.array(a_np, split=0)
+        _, r_chol = ht.linalg.qr(a, method="cholqr2")
+        _, r_tsqr = ht.linalg.qr(a, method="tsqr")
+        # QR is unique up to column signs of Q / row signs of R
+        np.testing.assert_allclose(
+            np.abs(np.asarray(r_chol.larray)), np.abs(np.asarray(r_tsqr.larray)), rtol=1e-3, atol=1e-4
+        )
+
+    def test_calc_q_false(self):
+        a = ht.random.randn(32, 3)
+        q, r = ht.linalg.qr(a, method="cholqr2", calc_q=False)
+        assert q is None and r.shape == (3, 3)
+
+    def test_breakdown_raises(self):
+        col = np.arange(24, dtype=np.float32)[:, None]
+        a_np = np.concatenate([col, col, col], axis=1)  # rank 1
+        with pytest.raises(ValueError, match="cholqr2 broke down"):
+            ht.linalg.qr(ht.array(a_np, split=0), method="cholqr2")
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="tall operand"):
+            ht.linalg.qr(ht.ones((3, 8)), method="cholqr2")
+        with pytest.raises(ValueError, match="unknown qr method"):
+            ht.linalg.qr(ht.ones((8, 3)), method="nope")
+
+    def test_complex_operand_unitary(self):
+        rng = np.random.default_rng(22)
+        a_np = (rng.standard_normal((40, 4)) + 1j * rng.standard_normal((40, 4))).astype(
+            np.complex64
+        )
+        q, r = ht.linalg.qr(ht.array(a_np, split=0), method="cholqr2")
+        q_np = np.asarray(q.larray)
+        np.testing.assert_allclose(q_np.conj().T @ q_np, np.eye(4), atol=3e-4)
+        np.testing.assert_allclose(q_np @ np.asarray(r.larray), a_np, atol=3e-4)

@@ -9,9 +9,18 @@ import numpy as np
 import pytest
 
 
-@pytest.mark.parametrize(
-    "script", ["knn_demo", "lasso_demo", "cluster_demo", "io_linalg_pipeline"]
-)
+SMOKE_SCRIPTS = [
+    "knn_demo",
+    "lasso_demo",
+    "cluster_demo",
+    "io_linalg_pipeline",
+    "svd_pca",
+    "nn_mnist_style",
+    "daso_training",
+]
+
+
+@pytest.mark.parametrize("script", SMOKE_SCRIPTS)
 def test_example_runs(script, capsys):
     runpy.run_path(f"examples/{script}.py", run_name="__main__")
     out = capsys.readouterr().out
@@ -25,3 +34,17 @@ def test_example_runs(script, capsys):
     if script == "io_linalg_pipeline":
         err = float(out.splitlines()[0].rsplit(" ", 1)[-1])
         assert err < 1e-2
+    if script == "svd_pca":
+        assert "explain" in out  # its own assert enforces >95% in 3 components
+
+
+def test_every_example_is_smoke_covered():
+    """New example scripts must join SMOKE_SCRIPTS — an example that CI
+    never runs is documentation rot waiting."""
+    import pathlib
+
+    here = pathlib.Path(__file__).resolve().parent.parent / "examples"
+    all_scripts = {p.stem for p in here.glob("*.py")}
+    assert all_scripts <= set(SMOKE_SCRIPTS), (
+        f"uncovered examples: {all_scripts - set(SMOKE_SCRIPTS)}"
+    )

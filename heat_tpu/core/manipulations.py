@@ -13,6 +13,7 @@ bounded-size+mask contortions jit would require.
 
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -402,18 +403,159 @@ def shape(a: DNDarray) -> Tuple[int, ...]:
 def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     """Sort along an axis, returning (values, indices) (reference
     manipulations.py:2267-2520: distributed sample-sort with Bcast pivots and
-    Alltoallv exchange; one sharded XLA sort here)."""
+    Alltoallv exchange).
+
+    Along a non-split axis this is one sharded XLA sort (no communication).
+    Along the *split* axis it runs a distributed merge-exchange sort
+    (odd-even transposition on sorted blocks, see :func:`_dist_sort`) so no
+    device ever materializes more than two blocks — the reference's
+    sample-sort role with a static-shape schedule.
+    """
     sanitation.sanitize_in(a)
     axis = sanitize_axis(a.shape, axis)
-    arr = a.larray
-    indices = jnp.argsort(arr, axis=axis, descending=descending, stable=True)
-    values = jnp.take_along_axis(arr, indices, axis=axis)
-    v = _wrap(values, a.split, a)
-    i = _wrap(indices.astype(types.index_dtype()), a.split, a)
+    # complex sorts lexicographically through the gather path (no total-order
+    # sentinel exists for the ragged pad slots)
+    use_dist = (
+        a.split == axis
+        and a.comm.size > 1
+        and not jnp.issubdtype(a.parray.dtype, jnp.complexfloating)
+    )
+    if use_dist:
+        sv, sg = _dist_sort(a, axis, descending)
+        # sv/sg leave the program at the padded physical shape, correctly
+        # sharded — the constructor stores such payloads as-is (no re-pad)
+        v = DNDarray(
+            sv, tuple(a.shape), types.canonical_heat_type(sv.dtype), a.split, a.device, a.comm
+        )
+        i = DNDarray(
+            sg.astype(types.index_dtype()),
+            tuple(a.shape),
+            types.canonical_heat_type(types.index_dtype()),
+            a.split,
+            a.device,
+            a.comm,
+        )
+    else:
+        arr = a.larray
+        indices = jnp.argsort(arr, axis=axis, descending=descending, stable=True)
+        values = jnp.take_along_axis(arr, indices, axis=axis)
+        v = _wrap(values, a.split, a)
+        i = _wrap(indices.astype(types.index_dtype()), a.split, a)
     if out is not None:
-        out._replace(v.larray, v.split)
+        # store the (possibly padded) physical payload as-is — _replace with
+        # gshape accepts it; going through larray would pad+place again
+        out._replace(v.parray, v.split, tuple(v.shape))
         return out, i
     return v, i
+
+
+def _sort_sentinel(dtype, descending: bool):
+    """A value that sorts to the global TAIL for pad slots.
+
+    XLA float sort follows the total order ``-NaN < -inf < … < +inf < NaN``.
+    Ascending, real NaNs land at the global tail, so a +inf sentinel would
+    sort *before* them and leak into the logical result — the sentinel must
+    be NaN itself; stability (pads carry the highest global positions) keeps
+    real NaNs ahead of pads. Descending, NaNs go to the *head*, the tail is
+    the -inf side, and -NaN cannot be used as a sentinel anyway (XLA
+    canonicalizes NaN signs) — -inf is correct there, with stability again
+    ordering pads after any real -inf. Ints/bools use the dtype extreme with
+    the same stability argument.
+    """
+    if jnp.issubdtype(dtype, jnp.floating):
+        return -jnp.inf if descending else jnp.nan
+    if jnp.issubdtype(dtype, jnp.bool_):
+        return not descending
+    info = jnp.iinfo(dtype)
+    return info.min if descending else info.max
+
+
+@functools.lru_cache(maxsize=None)
+def _dist_sort_program(mesh, axis_name: str, p: int, axis: int, ndim: int, descending: bool):
+    """Compiled odd-even merge-exchange sort over the block-sharded payload.
+
+    Each device keeps its (block, …) slice sorted; p rounds of pairwise
+    block merges (even pairs, then odd pairs, alternating) provably sort any
+    sequence of p blocks. Per round a device holds at most TWO blocks —
+    O(n/p) memory — and total exchange volume is p·(n/p) = n, the same bytes
+    one all-gather moves but without its O(n)-per-device memory. Global
+    indices ride along so ``sort`` can return the reference's (values,
+    indices) pair; ties keep ascending-global-position order (stable).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    spec_entries: list = [None] * ndim
+    spec_entries[axis] = axis_name
+    spec = P(*spec_entries)
+
+    def local_sort(v, g):
+        order = jnp.argsort(v, axis=axis, stable=True, descending=descending)
+        return jnp.take_along_axis(v, order, axis), jnp.take_along_axis(g, order, axis)
+
+    def kernel(v, g):
+        idx = jax.lax.axis_index(axis_name)
+        block = v.shape[axis]
+        v, g = local_sort(v, g)
+        for r in range(p):
+            partner = list(range(p))
+            for lo in range(r % 2, p - 1, 2):
+                partner[lo], partner[lo + 1] = lo + 1, lo
+            perm = [(d, partner[d]) for d in range(p)]
+            pv = jax.lax.ppermute(v, axis_name, perm)
+            pg = jax.lax.ppermute(g, axis_name, perm)
+            is_lower = jnp.asarray([partner[d] > d for d in range(p)])[idx]
+            is_paired = jnp.asarray([partner[d] != d for d in range(p)])[idx]
+            # concatenate in global order (lower device's block first) so the
+            # stable merge keeps equal keys in global-position order
+            first_v = jnp.where(is_lower, v, pv)
+            second_v = jnp.where(is_lower, pv, v)
+            first_g = jnp.where(is_lower, g, pg)
+            second_g = jnp.where(is_lower, pg, g)
+            cat_v = jnp.concatenate([first_v, second_v], axis=axis)
+            cat_g = jnp.concatenate([first_g, second_g], axis=axis)
+            order = jnp.argsort(cat_v, axis=axis, stable=True, descending=descending)
+            sv = jnp.take_along_axis(cat_v, order, axis)
+            sg = jnp.take_along_axis(cat_g, order, axis)
+            lo_v = jax.lax.slice_in_dim(sv, 0, block, axis=axis)
+            hi_v = jax.lax.slice_in_dim(sv, block, 2 * block, axis=axis)
+            lo_g = jax.lax.slice_in_dim(sg, 0, block, axis=axis)
+            hi_g = jax.lax.slice_in_dim(sg, block, 2 * block, axis=axis)
+            v = jnp.where(is_paired, jnp.where(is_lower, lo_v, hi_v), v)
+            g = jnp.where(is_paired, jnp.where(is_lower, lo_g, hi_g), g)
+        return v, g
+
+    return jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=(spec, spec),
+            out_specs=(spec, spec),
+            check_vma=False,
+        )
+    )
+
+
+def _dist_sort(a: DNDarray, axis: int, descending: bool):
+    """Driver for the split-axis distributed sort: sentinel the pad slots,
+    run the merge-exchange program. Returns the sorted values and global
+    indices at the PADDED physical shape (sentinels occupy the global tail,
+    exactly the pad+mask layout the DNDarray constructor stores as-is)."""
+    comm = a.comm
+    p = comm.size
+    phys = a.parray
+    n = a.shape[axis]
+    pos = jnp.arange(phys.shape[axis])
+    shape_1 = [1] * phys.ndim
+    shape_1[axis] = phys.shape[axis]
+    pos_b = pos.reshape(shape_1)
+    if phys.shape[axis] != n:  # ragged: pad slots must sort to the global tail
+        sentinel = _sort_sentinel(phys.dtype, descending)
+        phys = jnp.where(pos_b < n, phys, jnp.asarray(sentinel, phys.dtype))
+    gidx = jnp.broadcast_to(pos_b, phys.shape).astype(types.index_dtype())
+    phys = _ensure_split(phys, axis, comm)
+    gidx = _ensure_split(gidx, axis, comm)
+    fn = _dist_sort_program(comm.mesh, comm.axis_name, p, axis, phys.ndim, bool(descending))
+    return fn(phys, gidx)
 
 
 def squeeze(x: DNDarray, axis=None) -> DNDarray:

@@ -471,7 +471,9 @@ def _sort_sentinel(dtype, descending: bool):
 
 
 @functools.lru_cache(maxsize=None)
-def _dist_sort_program(mesh, axis_name: str, p: int, axis: int, ndim: int, descending: bool):
+def _dist_sort_program(
+    mesh, axis_name: str, p: int, axis: int, ndim: int, descending: bool, with_indices: bool = True
+):
     """Compiled odd-even merge-exchange sort over the block-sharded payload.
 
     Each device keeps its (block, …) slice sorted; p rounds of pairwise
@@ -490,9 +492,10 @@ def _dist_sort_program(mesh, axis_name: str, p: int, axis: int, ndim: int, desce
 
     def local_sort(v, g):
         order = jnp.argsort(v, axis=axis, stable=True, descending=descending)
-        return jnp.take_along_axis(v, order, axis), jnp.take_along_axis(g, order, axis)
+        v = jnp.take_along_axis(v, order, axis)
+        return v, (jnp.take_along_axis(g, order, axis) if g is not None else None)
 
-    def kernel(v, g):
+    def body(v, g):
         idx = jax.lax.axis_index(axis_name)
         block = v.shape[axis]
         v, g = local_sort(v, g)
@@ -502,44 +505,59 @@ def _dist_sort_program(mesh, axis_name: str, p: int, axis: int, ndim: int, desce
                 partner[lo], partner[lo + 1] = lo + 1, lo
             perm = [(d, partner[d]) for d in range(p)]
             pv = jax.lax.ppermute(v, axis_name, perm)
-            pg = jax.lax.ppermute(g, axis_name, perm)
             is_lower = jnp.asarray([partner[d] > d for d in range(p)])[idx]
             is_paired = jnp.asarray([partner[d] != d for d in range(p)])[idx]
             # concatenate in global order (lower device's block first) so the
             # stable merge keeps equal keys in global-position order
-            first_v = jnp.where(is_lower, v, pv)
-            second_v = jnp.where(is_lower, pv, v)
-            first_g = jnp.where(is_lower, g, pg)
-            second_g = jnp.where(is_lower, pg, g)
-            cat_v = jnp.concatenate([first_v, second_v], axis=axis)
-            cat_g = jnp.concatenate([first_g, second_g], axis=axis)
+            cat_v = jnp.concatenate(
+                [jnp.where(is_lower, v, pv), jnp.where(is_lower, pv, v)], axis=axis
+            )
             order = jnp.argsort(cat_v, axis=axis, stable=True, descending=descending)
             sv = jnp.take_along_axis(cat_v, order, axis)
-            sg = jnp.take_along_axis(cat_g, order, axis)
             lo_v = jax.lax.slice_in_dim(sv, 0, block, axis=axis)
             hi_v = jax.lax.slice_in_dim(sv, block, 2 * block, axis=axis)
-            lo_g = jax.lax.slice_in_dim(sg, 0, block, axis=axis)
-            hi_g = jax.lax.slice_in_dim(sg, block, 2 * block, axis=axis)
-            v = jnp.where(is_paired, jnp.where(is_lower, lo_v, hi_v), v)
-            g = jnp.where(is_paired, jnp.where(is_lower, lo_g, hi_g), g)
+            new_v = jnp.where(is_paired, jnp.where(is_lower, lo_v, hi_v), v)
+            if g is not None:
+                pg = jax.lax.ppermute(g, axis_name, perm)
+                cat_g = jnp.concatenate(
+                    [jnp.where(is_lower, g, pg), jnp.where(is_lower, pg, g)], axis=axis
+                )
+                sg = jnp.take_along_axis(cat_g, order, axis)
+                lo_g = jax.lax.slice_in_dim(sg, 0, block, axis=axis)
+                hi_g = jax.lax.slice_in_dim(sg, block, 2 * block, axis=axis)
+                g = jnp.where(is_paired, jnp.where(is_lower, lo_g, hi_g), g)
+            v = new_v
         return v, g
+
+    if with_indices:
+        kernel = body
+        in_specs = (spec, spec)
+        out_specs = (spec, spec)
+    else:
+        def kernel(v):
+            return body(v, None)[0]
+
+        in_specs = (spec,)
+        out_specs = spec
 
     return jax.jit(
         jax.shard_map(
             kernel,
             mesh=mesh,
-            in_specs=(spec, spec),
-            out_specs=(spec, spec),
+            in_specs=in_specs,
+            out_specs=out_specs,
             check_vma=False,
         )
     )
 
 
-def _dist_sort(a: DNDarray, axis: int, descending: bool):
+def _dist_sort(a: DNDarray, axis: int, descending: bool, with_indices: bool = True):
     """Driver for the split-axis distributed sort: sentinel the pad slots,
     run the merge-exchange program. Returns the sorted values and global
     indices at the PADDED physical shape (sentinels occupy the global tail,
-    exactly the pad+mask layout the DNDarray constructor stores as-is)."""
+    exactly the pad+mask layout the DNDarray constructor stores as-is).
+    ``with_indices=False`` (e.g. unique) skips the companion index payload,
+    halving the network's exchange volume."""
     comm = a.comm
     p = comm.size
     phys = a.parray
@@ -551,10 +569,14 @@ def _dist_sort(a: DNDarray, axis: int, descending: bool):
     if phys.shape[axis] != n:  # ragged: pad slots must sort to the global tail
         sentinel = _sort_sentinel(phys.dtype, descending)
         phys = jnp.where(pos_b < n, phys, jnp.asarray(sentinel, phys.dtype))
-    gidx = jnp.broadcast_to(pos_b, phys.shape).astype(types.index_dtype())
     phys = _ensure_split(phys, axis, comm)
+    fn = _dist_sort_program(
+        comm.mesh, comm.axis_name, p, axis, phys.ndim, bool(descending), bool(with_indices)
+    )
+    if not with_indices:
+        return fn(phys)
+    gidx = jnp.broadcast_to(pos_b, phys.shape).astype(types.index_dtype())
     gidx = _ensure_split(gidx, axis, comm)
-    fn = _dist_sort_program(comm.mesh, comm.axis_name, p, axis, phys.ndim, bool(descending))
     return fn(phys, gidx)
 
 
@@ -690,11 +712,44 @@ def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool 
 
 
 def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis: Optional[int] = None):
-    """Unique elements (reference manipulations.py:3055-3264). Eager execution
-    permits the data-dependent output shape directly."""
+    """Unique elements (reference manipulations.py:3055-3264: local uniques,
+    then a reduced exchange). Eager execution permits the data-dependent
+    output shape directly.
+
+    Flat unique of a split array rides the distributed merge-exchange sort:
+    sort in place over the mesh (O(n/p) per-device memory), mark
+    first-occurrence flags with one global shift, and gather only the k
+    unique values — the full operand is never gathered. ``return_inverse``,
+    axis-unique, and complex dtypes use the dense path.
+    """
     sanitation.sanitize_in(a)
     if axis is not None:
         axis = sanitize_axis(a.shape, axis)
+    use_dist = (
+        axis is None
+        and not return_inverse
+        and a.split is not None
+        and a.comm.size > 1
+        and a.ndim >= 1
+        and a.size > 0
+        and not jnp.issubdtype(a.parray.dtype, jnp.complexfloating)
+    )
+    if use_dist:
+        flat = ravel(a) if a.ndim > 1 else a
+        sv = _dist_sort(flat, 0, False, with_indices=False)
+        n = flat.shape[0]
+        svl = sv[:n] if sv.shape[0] != n else sv  # logical view (drops sentinels)
+        flags = svl[1:] != svl[:-1]
+        if jnp.issubdtype(svl.dtype, jnp.floating):
+            # collapse NaN runs like jnp.unique (equal_nan): NaN != NaN is
+            # True elementwise, but consecutive NaNs are not new values
+            both_nan = jnp.isnan(svl[1:]) & jnp.isnan(svl[:-1])
+            flags = flags & ~both_nan
+        flags = jnp.concatenate([jnp.ones((1,), bool), flags])
+        k = int(flags.sum())  # host sync — eager API, data-dependent shape
+        idxs = jnp.nonzero(flags, size=k)[0]
+        res = jnp.take(svl, idxs)  # gathers k elements, not n
+        return _wrap(res, 0, a)
     if return_inverse:
         res, inverse = jnp.unique(a.larray, return_inverse=True, axis=axis)
         split = 0 if a.split is not None else None

@@ -1,0 +1,124 @@
+"""I/O dispatch and error-path depth (reference test_io.py patterns):
+unknown extensions, bad argument types, missing files/datasets, mode
+validation, and the load/save round-trip through every dispatcher."""
+
+import os
+import pathlib
+import tempfile
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from harness import TestCase
+
+
+def _tmp(name):
+    d = pathlib.Path(tempfile.mkdtemp())
+    return str(d / name)
+
+
+class TestDispatch(TestCase):
+    def test_load_unknown_extension(self):
+        with pytest.raises(ValueError):
+            ht.load("data.unknown_ext")
+
+    def test_save_unknown_extension(self):
+        with pytest.raises(ValueError):
+            ht.save(ht.ones(4), "data.unknown_ext")
+
+    def test_load_nonstring_path(self):
+        with pytest.raises(TypeError):
+            ht.load(42)
+
+    def test_round_trip_every_format(self):
+        x_np = np.arange(24, dtype=np.float32).reshape(6, 4)
+        for ext, kwargs in (("h5", {"dataset": "d"}), ("nc", {"variable": "d"}), ("csv", {})):
+            path = _tmp(f"rt.{ext}")
+            x = ht.array(x_np, split=0)
+            if ext == "h5":
+                ht.save(x, path, "d")
+                back = ht.load(path, dataset="d", split=0)
+            elif ext == "nc":
+                ht.save(x, path, "d")
+                back = ht.load(path, variable="d", split=0)
+            else:
+                ht.save(x, path)
+                back = ht.load(path, split=0)
+            self.assert_array_equal(back, x_np)
+
+
+class TestHDF5Errors(TestCase):
+    def test_missing_file(self):
+        with pytest.raises((IOError, OSError, FileNotFoundError)):
+            ht.load_hdf5("/nonexistent/dir/file.h5", "data")
+
+    def test_missing_dataset(self):
+        import h5py
+
+        path = _tmp("d.h5")
+        with h5py.File(path, "w") as f:
+            f["present"] = np.arange(4.0)
+        with pytest.raises(KeyError):
+            ht.load_hdf5(path, "absent")
+
+    def test_bad_argument_types(self):
+        with pytest.raises(TypeError):
+            ht.load_hdf5(1, "data")
+        with pytest.raises(TypeError):
+            ht.load_hdf5("f.h5", dataset=7)
+
+    def test_load_fraction(self):
+        import h5py
+
+        path = _tmp("f.h5")
+        with h5py.File(path, "w") as f:
+            f["data"] = np.arange(100.0).astype(np.float32)
+        part = ht.load_hdf5(path, "data", load_fraction=0.5, split=0)
+        assert part.shape[0] == 50
+
+    def test_save_append_mode(self):
+        path = _tmp("a.h5")
+        ht.save_hdf5(ht.arange(6, dtype=ht.float32), path, "one")
+        ht.save_hdf5(ht.arange(4, dtype=ht.float32), path, "two", mode="a")
+        assert ht.load_hdf5(path, "one").shape == (6,)
+        assert ht.load_hdf5(path, "two").shape == (4,)
+
+
+class TestCSVErrors(TestCase):
+    def test_bad_sep_type(self):
+        with pytest.raises(TypeError):
+            ht.load_csv("x.csv", sep=3)
+
+    def test_header_lines(self):
+        path = _tmp("h.csv")
+        with open(path, "w") as f:
+            f.write("col_a,col_b\n1,2\n3,4\n")
+        x = ht.load_csv(path, header_lines=1, split=0)
+        self.assert_array_equal(x, np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+
+    def test_custom_sep(self):
+        path = _tmp("s.csv")
+        with open(path, "w") as f:
+            f.write("1;2;3\n4;5;6\n")
+        x = ht.load_csv(path, sep=";")
+        self.assert_array_equal(x, np.array([[1, 2, 3], [4, 5, 6]], np.float32))
+
+
+class TestNetCDFErrors(TestCase):
+    def test_netcdf3_rejected(self):
+        path = _tmp("c.nc")
+        # classic NETCDF3 magic: 'CDF\x01'
+        with open(path, "wb") as f:
+            f.write(b"CDF\x01" + b"\x00" * 32)
+        with pytest.raises((ValueError, OSError, RuntimeError)):
+            ht.load_netcdf(path, variable="v")
+
+    def test_round_trip_preserves_dtype(self):
+        path = _tmp("t.nc")
+        x = ht.arange(10, dtype=ht.int32, split=0)
+        ht.save_netcdf(x, path, "v")
+        back = ht.load_netcdf(path, variable="v", split=0, dtype=ht.int32)
+        assert back.dtype == ht.int32
+        np.testing.assert_array_equal(back.numpy().astype(np.int64), np.arange(10))

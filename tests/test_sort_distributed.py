@@ -118,3 +118,48 @@ class TestDistSortFloatEdges(TestCase):
         z = (np.arange(5)[::-1] + 1j * np.arange(5)).astype(np.complex64)
         zv, _ = ht.sort(ht.array(z, split=0))
         np.testing.assert_array_equal(np.asarray(zv.larray), np.sort_complex(z))
+
+
+class TestDistUnique(TestCase):
+    """Flat unique of split arrays rides the sort network (reduced gather)."""
+
+    def test_oracle_with_duplicates(self):
+        rng = np.random.default_rng(3)
+        for n in (40, 37, 9):
+            x_np = rng.integers(0, 12, n).astype(np.int64)
+            u = ht.unique(ht.array(x_np, split=0))
+            np.testing.assert_array_equal(np.asarray(u.larray), np.unique(x_np))
+            assert u.split == 0
+
+    def test_2d_split1_flattens(self):
+        rng = np.random.default_rng(4)
+        m_np = rng.integers(0, 5, (7, 4)).astype(np.float32)
+        u = ht.unique(ht.array(m_np, split=1))
+        np.testing.assert_array_equal(np.asarray(u.larray), np.unique(m_np))
+
+    def test_degenerate_cases(self):
+        np.testing.assert_array_equal(
+            np.asarray(ht.unique(ht.full((13,), 2.0, split=0)).larray), [2.0]
+        )
+        distinct = np.arange(11.0, dtype=np.float32)[::-1].copy()
+        np.testing.assert_array_equal(
+            np.asarray(ht.unique(ht.array(distinct, split=0)).larray), np.sort(distinct)
+        )
+
+    def test_return_inverse_path_consistent(self):
+        x_np = np.array([3, 1, 3, 2, 1, 2, 2], np.int32)
+        u, inv = ht.unique(ht.array(x_np, split=0), return_inverse=True)
+        np.testing.assert_array_equal(
+            np.asarray(u.larray)[np.asarray(inv.larray)], x_np
+        )
+
+    def test_nan_collapse_matches_dense_path(self):
+        x_np = np.array([np.nan, 1.0, np.nan, 2.0, np.nan], np.float32)
+        u = ht.unique(ht.array(x_np, split=0))
+        got = np.asarray(u.larray)
+        assert got.shape == (3,), got  # 1.0, 2.0, one collapsed NaN
+        assert np.isnan(got[-1]) and np.array_equal(got[:2], [1.0, 2.0])
+
+    def test_empty_split_array(self):
+        u = ht.unique(ht.array(np.empty(0, np.float32), split=0))
+        assert tuple(u.shape) == (0,)

@@ -367,10 +367,45 @@ def _moment_stat(x, axis, order, unbiased, fischer=True):
     return _wrap(jnp.asarray(g), _reduced_split(x, axis), x)
 
 
+def _nan_propagating(op):
+    """numpy max/min semantics: any NaN in the reduced window wins.
+
+    XLA's *local* maximum propagates NaN, but the cross-device all-reduce
+    combiner does not (C-max semantics — the reference's MPI.MAX has the
+    identical hole), so a sharded reduce could silently drop NaN depending
+    on the mesh size. One explicit isnan any-reduction restores the numpy
+    contract deterministically; the pad-aware fast path stays safe because
+    pad-slot NaNs only ever land in pad slots of the result.
+    """
+
+    def fn(src, axis=None, keepdims=False, **kw):
+        res = op(src, axis=axis, keepdims=keepdims, **kw)
+        if jnp.issubdtype(src.dtype, jnp.floating):
+            has_nan = jnp.any(jnp.isnan(src), axis=axis, keepdims=keepdims)
+            res = jnp.where(has_nan, jnp.asarray(jnp.nan, res.dtype), res)
+        return res
+
+    return fn
+
+
+def _reduction_crosses_split(x: DNDarray, axis) -> bool:
+    if x.split is None:
+        return False
+    if axis is None:
+        return True
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    ndim = x.ndim
+    return any((a % ndim if ndim else a) == x.split for a in axes)
+
+
 def max(x: DNDarray, axis=None, out=None, keepdims=False, keepdim=None) -> DNDarray:
     """Maximum along axis (reference statistics.py:785-901). ``keepdim`` is
     the reference's torch-style alias for ``keepdims``."""
-    return _reduce_op(jnp.max, x, axis, out=out, keepdims=keepdims if keepdim is None else keepdim)
+    # XLA's local max propagates NaN; only the cross-device combine needs
+    # the explicit pass (see _nan_propagating) — skip the extra traffic
+    # for purely-local reductions
+    op = _nan_propagating(jnp.max) if _reduction_crosses_split(x, axis) else jnp.max
+    return _reduce_op(op, x, axis, out=out, keepdims=keepdims if keepdim is None else keepdim)
 
 
 def maximum(x1: DNDarray, x2: DNDarray, out=None) -> DNDarray:
@@ -410,7 +445,8 @@ def median(x: DNDarray, axis: Optional[int] = None, keepdims: bool = False, keep
 def min(x: DNDarray, axis=None, out=None, keepdims=False, keepdim=None) -> DNDarray:
     """Minimum along axis (reference statistics.py:1114-1230). ``keepdim`` is
     the reference's torch-style alias for ``keepdims``."""
-    return _reduce_op(jnp.min, x, axis, out=out, keepdims=keepdims if keepdim is None else keepdim)
+    op = _nan_propagating(jnp.min) if _reduction_crosses_split(x, axis) else jnp.min
+    return _reduce_op(op, x, axis, out=out, keepdims=keepdims if keepdim is None else keepdim)
 
 
 def minimum(x1: DNDarray, x2: DNDarray, out=None) -> DNDarray:
@@ -543,7 +579,11 @@ def mpi_argmax(a, b):
     fn of a ``lax.psum``-style tree or ``jax.lax.reduce`` over shards."""
     av, ai = a
     bv, bi = b
-    take_b = bv > av
+    # NaN-aware (numpy argmax returns the first NaN's index): a NaN side
+    # wins; both-NaN keeps the lower-index accumulator. No-op for ints.
+    a_nan = jnp.isnan(av) if jnp.issubdtype(av.dtype, jnp.floating) else jnp.zeros_like(av, bool)
+    b_nan = jnp.isnan(bv) if jnp.issubdtype(bv.dtype, jnp.floating) else jnp.zeros_like(bv, bool)
+    take_b = ((bv > av) | b_nan) & ~a_nan
     return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
 
 
@@ -552,5 +592,7 @@ def mpi_argmin(a, b):
     (reference statistics.py:1371-1405)."""
     av, ai = a
     bv, bi = b
-    take_b = bv < av
+    a_nan = jnp.isnan(av) if jnp.issubdtype(av.dtype, jnp.floating) else jnp.zeros_like(av, bool)
+    b_nan = jnp.isnan(bv) if jnp.issubdtype(bv.dtype, jnp.floating) else jnp.zeros_like(bv, bool)
+    take_b = ((bv < av) | b_nan) & ~a_nan
     return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)

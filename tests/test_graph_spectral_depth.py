@@ -1,0 +1,79 @@
+"""Graph Laplacian + spectral clustering depth (reference
+graph/tests/test_laplacian.py and cluster/tests/test_spectral.py patterns):
+mathematical-property oracles for both Laplacian definitions, eNeighbour
+thresholding, and spectral end-to-end separation."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.graph import Laplacian
+
+from harness import TestCase
+
+
+def _points(seed=0, n=20, f=3):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n, f)).astype(np.float32)
+
+
+class TestLaplacianMath(TestCase):
+    def test_simple_definition_rows_sum_zero(self):
+        x = ht.array(_points(), split=0)
+        lap = Laplacian(lambda a: ht.spatial.rbf(a, sigma=1.0), definition="simple")
+        L = np.asarray(lap.construct(x).larray)
+        # L = D - A: rows sum to the removed self-similarity (diag excluded)
+        np.testing.assert_allclose(L, L.T, atol=1e-5)  # symmetric
+        assert (np.diag(L) >= 0).all()
+        # eigenvalues non-negative (PSD) and smallest ~0
+        w = np.linalg.eigvalsh(L)
+        assert w.min() > -1e-4
+
+    def test_norm_sym_unit_diagonal_and_psd(self):
+        x = ht.array(_points(1), split=0)
+        lap = Laplacian(lambda a: ht.spatial.rbf(a, sigma=1.0), definition="norm_sym")
+        L = np.asarray(lap.construct(x).larray)
+        np.testing.assert_allclose(np.diag(L), 1.0, atol=1e-5)
+        w = np.linalg.eigvalsh(L)
+        assert w.min() > -1e-4 and w.max() < 2.0 + 1e-4  # norm_sym spectrum ⊂ [0, 2]
+
+    def test_eneighbour_thresholding_sparsifies(self):
+        x = ht.array(_points(2), split=0)
+        dense = Laplacian(
+            lambda a: ht.spatial.rbf(a, sigma=1.0), definition="simple"
+        ).construct(x)
+        sparse = Laplacian(
+            lambda a: ht.spatial.rbf(a, sigma=1.0),
+            definition="simple",
+            mode="eNeighbour",
+            threshold_key="upper",
+            threshold_value=0.5,
+        ).construct(x)
+        nd = np.asarray(dense.larray)
+        ns = np.asarray(sparse.larray)
+        off_d = nd - np.diag(np.diag(nd))
+        off_s = ns - np.diag(np.diag(ns))
+        assert np.count_nonzero(off_s) <= np.count_nonzero(off_d)
+
+    def test_validation(self):
+        with pytest.raises(NotImplementedError):
+            Laplacian(lambda a: a, definition="other")
+        with pytest.raises(NotImplementedError):
+            Laplacian(lambda a: a, mode="knn")
+        with pytest.raises(ValueError):
+            Laplacian(lambda a: a, threshold_key="middle")
+
+
+class TestSpectralEndToEnd(TestCase):
+    def test_two_blob_separation(self):
+        rng = np.random.default_rng(3)
+        a = rng.standard_normal((16, 2)).astype(np.float32) * 0.3 + 4
+        b = rng.standard_normal((16, 2)).astype(np.float32) * 0.3 - 4
+        pts = ht.array(np.concatenate([a, b]), split=0)
+        from heat_tpu.cluster import Spectral
+
+        model = Spectral(n_clusters=2, gamma=0.5, n_lanczos=12)
+        labels = np.asarray(model.fit(pts).labels_.larray)
+        first, second = labels[:16], labels[16:]
+        assert len(np.unique(first)) == 1 and len(np.unique(second)) == 1
+        assert first[0] != second[0]

@@ -146,3 +146,14 @@ class TestDivisionEdges(TestCase):
         e_np = np.array([0.0, 2.0, 3.0, 0.5], np.float32)
         got = np.asarray(ht.pow(ht.array(a_np, split=0), ht.array(e_np, split=0)).larray)
         np.testing.assert_allclose(got, np.power(a_np, e_np), rtol=1e-6)
+
+    def test_arg_reductions_return_first_nan(self):
+        p = ht.get_comm().size
+        x_np = np.full(2 * p, 1.0, np.float32)
+        x_np[min(3, 2 * p - 1)] = np.nan
+        x_np[0] = 5.0
+        x = ht.array(x_np, split=0)
+        assert int(ht.argmax(x).item()) == np.argmax(x_np)
+        assert int(ht.argmin(x).item()) == np.argmin(x_np)
+        # consistency: the max value at the argmax index is NaN too
+        assert np.isnan(float(ht.max(x).item()))

@@ -22,7 +22,7 @@ def main() -> None:
 
     # center, decompose, project — all sharded over the sample axis
     x = x - ht.mean(x, axis=0)
-    u, s, vh = ht.linalg.svd(x)
+    u, s, vh = ht.linalg.svd(x, full_matrices=False)
     explained = (s * s) / float(ht.sum(s * s).item())
     scores = x @ vh.T[:, :k]  # (n, k), split preserved
 

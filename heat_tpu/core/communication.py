@@ -124,15 +124,19 @@ def allreduce(x, axis: str, op: Union[str, Callable] = "sum", size: Optional[int
     if op == "lor":
         return jax.tree.map(lambda l: jax.lax.pmax(l.astype(jnp.uint8), axis).astype(jnp.bool_), x)
     # prod / custom combiner: gather the contributions (size is static) and
-    # fold — the XLA rendering of an arbitrary MPI reduce op
+    # fold — the XLA rendering of an arbitrary MPI reduce op. The fold is a
+    # fori_loop, not an unrolled chain: program size stays O(1) in the mesh
+    # size (the 64-chip compile-scaling requirement, tests/test_mesh64_compile)
     if size is None:
         raise ValueError("custom/prod allreduce needs the static axis size")
     combine = _combine(op)
     gathered = jax.tree.map(lambda l: jax.lax.all_gather(l, axis), x)
-    acc = jax.tree.map(lambda g: g[0], gathered)
-    for i in range(1, size):
-        acc = combine(acc, jax.tree.map(lambda g: g[i], gathered))
-    return acc
+    acc0 = jax.tree.map(lambda g: g[0], gathered)
+
+    def fold(i, acc):
+        return combine(acc, jax.tree.map(lambda g: g[i], gathered))
+
+    return jax.lax.fori_loop(1, size, fold, acc0)
 
 
 def allgather(x, axis: str, gather_axis: int = 0, tiled: bool = False):
@@ -190,11 +194,16 @@ def exscan(x, axis: str, size: int, op: Union[str, Callable] = "sum", neutral=No
         neutral = _neutral(op, x)
     combine = _combine(op)
     gathered = jax.tree.map(lambda l: jax.lax.all_gather(l, axis), x)
-    out = acc = neutral
-    # size is static: unrolled fold; device d keeps the prefix of shards < d
-    for i in range(size - 1):
+
+    # fori_loop fold (O(1) program size in the mesh size): device d keeps
+    # the prefix of shards < d
+    def fold(i, carry):
+        out, acc = carry
         acc = combine(acc, jax.tree.map(lambda g: g[i], gathered))
         out = jax.tree.map(lambda o, a: jnp.where(idx > i, a, o), out, acc)
+        return out, acc
+
+    out, _ = jax.lax.fori_loop(0, size - 1, fold, (neutral, neutral))
     return out
 
 
@@ -418,6 +427,17 @@ class MeshCommunication(Communication):
         return f"MeshCommunication({self.size} {plat} device(s), axis={self.axis_name!r})"
 
 
+def _distributed_client_live() -> bool:
+    """Whether ``jax.distributed`` is already connected, probed from runtime
+    state rather than by parsing exception wording (which changes across JAX
+    versions). Conservative: any probe failure reads as "not connected"."""
+    try:
+        state = jax._src.distributed.global_state
+        return getattr(state, "client", None) is not None
+    except Exception:
+        return False
+
+
 def initialize(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -439,6 +459,14 @@ def initialize(
     :func:`use_comm`).
     """
     global MESH_WORLD, MESH_SELF, __default_comm
+    if _distributed_client_live():
+        # state probe, not message parsing: the runtime is already connected,
+        # so re-initialization is a no-op regardless of how a second
+        # ``jax.distributed.initialize`` would word its complaint
+        MESH_WORLD = MeshCommunication()
+        MESH_SELF = MeshCommunication(jax.devices()[:1])
+        __default_comm = MESH_WORLD
+        return MESH_WORLD
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -454,9 +482,12 @@ def initialize(
             int(os.environ.get("SLURM_NTASKS", "1") or 1),
             int(os.environ.get("OMPI_COMM_WORLD_SIZE", "1") or 1),
             int(os.environ.get("PMI_SIZE", "1") or 1),
+            int(os.environ.get("WORLD_SIZE", "1") or 1),  # torchrun et al.
         )
         single = (num_processes is None or num_processes == 1) and hinted_world == 1
-        if ("already" in msg or "once" in msg) and "in use" not in msg:
+        if _distributed_client_live() or (
+            ("already" in msg or "once" in msg) and "in use" not in msg
+        ):
             pass  # connected earlier: keep the live service (idempotent)
         elif single and ("must be called before" in msg or "coordinator_address" in msg):
             # backend already up, or no cluster to auto-detect, in a genuinely

@@ -429,16 +429,44 @@ def save_csv(
     encoding: str = "utf-8",
     **kwargs,
 ) -> None:
-    """Save to CSV (reference io.py:926-1059)."""
+    """Save to CSV (reference io.py:926-1059: rank-by-rank serialized writes
+    without a global gather).
+
+    Split arrays stream shard by shard in rank order — each device's block is
+    brought to host and appended on its own (the single-controller edition of
+    the reference's token ring); the global array is NEVER materialized. A
+    split-1 operand is resharded to rows first (one alltoall — CSV is a
+    row-major format), and a replicated operand's local payload already is
+    the data.
+    """
     if not isinstance(data, DNDarray):
         raise TypeError(f"data must be a DNDarray, but was {type(data)}")
     if not isinstance(path, str):
         raise TypeError(f"path must be str, but was {type(path)}")
     if data.ndim > 2:
         raise ValueError("CSV can only store 1-D or 2-D arrays")
-    arr = data.numpy()
-    if arr.ndim == 1:
-        arr = arr[:, None]
+
+    if data.split == 1:
+        from .manipulations import resplit as _resplit
+
+        data = _resplit(data, 0)
+
+    def row_blocks():
+        """Logical row blocks in rank order, one host transfer each."""
+        if data.split is None or data.comm.size == 1:
+            arr = np.asarray(data.larray)  # local payload, not a gather
+            yield arr if arr.ndim == 2 else arr[:, None]
+            return
+        counts, _ = data.comm.counts_displs_shape(data.shape, 0)
+        phys = data.parray
+        block = int(phys.shape[0]) // data.comm.size
+        shards = sorted(phys.addressable_shards, key=lambda s: s.index[0].start or 0)
+        for s in shards:
+            r = (s.index[0].start or 0) // block if block else 0
+            c = counts[r]
+            if c:
+                arr = np.asarray(s.data[:c])
+                yield arr if arr.ndim == 2 else arr[:, None]
 
     def write_header(f):
         for line in header_lines or ():
@@ -449,8 +477,9 @@ def save_csv(
     # path (float64 transport would corrupt int64 > 2^53); the sep/encoding
     # guards mirror load_csv's native gate, and like load_csv any native
     # failure falls back to the python writer.
+    npdtype = np.dtype(data.dtype.jax_type())
     if (
-        np.issubdtype(arr.dtype, np.floating)
+        np.issubdtype(npdtype, np.floating)
         and len(sep) == 1
         and ord(sep) < 128
         and encoding.replace("-", "").lower() in ("utf8", "ascii")
@@ -461,7 +490,8 @@ def save_csv(
             if _native.native_available():
                 with open(path, "w", encoding=encoding, newline="") as f:
                     write_header(f)
-                _native.csv_write(path, arr, sep=sep, decimals=decimals, append=True)
+                for block_arr in row_blocks():
+                    _native.csv_write(path, block_arr, sep=sep, decimals=decimals, append=True)
                 return
         except Exception:
             pass  # fall through to the python writer (rewrites from scratch)
@@ -470,5 +500,6 @@ def save_csv(
         write_header(f)
         # match the native writer's row terminator (csv defaults to \r\n)
         writer = csv_module.writer(f, delimiter=sep, lineterminator="\n")
-        for row in arr:
-            writer.writerow([fmt % v if decimals >= 0 else v for v in row])
+        for block_arr in row_blocks():
+            for row in block_arr:
+                writer.writerow([fmt % v if decimals >= 0 else v for v in row])

@@ -1,0 +1,54 @@
+"""Shared scaffolding for the fused blocked shard_map programs (triangular
+solve in :mod:`.solver`, determinant in :mod:`.basics`).
+
+Both programs sweep diagonal-owner stages over a split-0 operand's PHYSICAL
+payload; they share two invariants that must never drift apart:
+
+* the stage grid and diagonal ownership come from the
+  :class:`~heat_tpu.core.tiling.SquareDiagTiles` decomposition (one tile per
+  device — the runtime's ceil-chunk grid, tiling.py:_axis_tile_sizes), and
+* each device's row slab is column-padded to the square physical extent and
+  its pad rows (unspecified content per the ``dndarray.parray`` contract)
+  are replaced with identity rows, so padding contributes an identity block
+  (zero solution rows / determinant factor 1).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def stage_grid(a) -> Tuple[int, int, int, tuple]:
+    """``(p, rows_loc, n_stages, owners)`` for a split-0 2-D operand.
+
+    ``owners[t]`` is the device owning stage ``t``'s diagonal tile, read from
+    the tile decomposition's ownership map; stages exist only where the
+    diagonal has logical rows.
+    """
+    from ..tiling import SquareDiagTiles
+
+    comm = a.comm
+    p = comm.size
+    n = int(a.shape[0])
+    rows_loc = -(-n // p)
+    tiles = SquareDiagTiles(a, tiles_per_proc=1)
+    n_stages = len(tiles.row_indices)
+    owners = tuple(
+        int(tiles.tile_map[i, min(i, tiles.tile_columns - 1), 2]) for i in range(n_stages)
+    )
+    return p, rows_loc, n_stages, owners
+
+
+def sanitize_slab(Al, idx, rows_loc: int, n: int, n_pad: int, dtype):
+    """Column-pad a device's physical ``(rows_loc, n)`` row slab to
+    ``(rows_loc, n_pad)`` and replace pad rows with identity rows.
+
+    Returns ``(slab, rows)`` where ``rows`` are the slab's global row ids
+    (callers reuse them to zero pad entries of the right-hand side).
+    """
+    rows = idx * rows_loc + jnp.arange(rows_loc)
+    W = jnp.pad(Al.astype(dtype), ((0, 0), (0, n_pad - n)))
+    eye_rows = (rows[:, None] == jnp.arange(n_pad)[None, :]).astype(dtype)
+    return jnp.where((rows >= n)[:, None], eye_rows, W), rows

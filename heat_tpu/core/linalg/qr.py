@@ -102,14 +102,14 @@ def qr(
         r_split = 1
     else:
         # replicated or short-wide: one XLA QR kernel over the gathered
-        # operand — explicit policy with a size guard, never silent
+        # operand — explicit policy with a size guard, never silent (the
+        # shared warn_replicated helper so callers can filter one class)
         if a.is_distributed() and a.size > _REPLICATED_MAX_ELEMENTS:
-            warnings.warn(
-                f"qr falls back to a replicated kernel for shape {a.shape} "
-                f"split={a.split} (no gather-free distributed schedule for "
-                "this shape: short-wide, or row blocks narrower than n); "
-                "consider resplit or a transpose formulation",
-                stacklevel=2,
+            sanitation.warn_replicated(
+                "qr",
+                f"no gather-free distributed schedule for shape {a.shape} "
+                f"split={a.split} (short-wide, or row blocks narrower than "
+                "n); consider resplit or a transpose formulation",
             )
         q_arr, r_arr = jnp.linalg.qr(a.larray, mode="reduced")
         r_split = 1 if a.split == 1 else None
@@ -198,10 +198,11 @@ def _panel_program(mesh, axis: str, m: int, c: int, n: int, p: int, dtype_name: 
 
     def kernel(a_loc):  # (m, c) per device
         idx = jax.lax.axis_index(axis)
-        q_loc = jnp.zeros_like(a_loc)
-        r_loc = jnp.zeros((n, c), a_loc.dtype)
-        a_cur = a_loc
-        for d in range(p):
+
+        # fori_loop over the p panels (not an unrolled chain): program size
+        # stays O(1) in the mesh size — tests/test_mesh64_compile
+        def panel(d, carry):
+            a_cur, q_loc, r_loc = carry
             # panel owner factors its (already orthogonalized) panel; every
             # device computes a QR but only the owner's is broadcast — the
             # XLA rendering of the reference's per-panel Bcast (qr.py:907-955)
@@ -221,6 +222,11 @@ def _panel_program(mesh, axis: str, m: int, c: int, n: int, p: int, dtype_name: 
             )
             r_loc = jax.lax.dynamic_update_slice(r_loc, r_rows, (d * c, 0))
             q_loc = jnp.where(idx == d, qd, q_loc)
+            return a_cur, q_loc, r_loc
+
+        _, q_loc, r_loc = jax.lax.fori_loop(
+            0, p, panel, (a_loc, jnp.zeros_like(a_loc), jnp.zeros((n, c), a_loc.dtype))
+        )
         return q_loc, r_loc
 
     return jax.jit(

@@ -72,18 +72,89 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
     return x
 
 
-def solve_triangular(A: DNDarray, b: DNDarray, lower: bool = False) -> DNDarray:
-    """Solve ``A x = b`` for triangular ``A`` by blocked substitution over
-    the :class:`~heat_tpu.core.tiling.SquareDiagTiles` decomposition.
+@functools.lru_cache(maxsize=None)
+def _tri_solve_program(mesh, axis, p, n, k, rows_loc, n_stages, owners, lower, dtype_name):
+    """Fused distributed blocked substitution (one jitted shard_map program).
 
-    The tile grid supplies the diagonal-aligned block bounds (the same
-    decomposition the reference builds to drive tile-QR, reference
-    tiling.py:331-1257); the sweep solves one diagonal tile with the XLA
-    triangular kernel and folds the off-diagonal tiles into the right-hand
-    side — MXU matmuls between small triangular solves.
+    ``A`` arrives as the PHYSICAL split-0 payload ``(p*rows_loc, n)`` —
+    rows padded per the dndarray.parray contract — and ``b`` zero-padded to
+    the same leading extent. The sweep runs ``n_stages`` stages inside a
+    ``fori_loop`` (program size is O(1) in ``p`` — the compile-time-scaling
+    requirement); stage ``t``:
+
+      1. the diagonal owner (``owners[t]`` — the SquareDiagTiles ownership
+         grid) solves its ``(rows_loc, rows_loc)`` diagonal tile against its
+         current local rhs with the XLA triangular kernel,
+      2. ONE psum of the solved ``(rows_loc, k)`` block replicates it,
+      3. every device folds ``A[:, tile t] @ x_t`` out of its local rhs —
+         the off-diagonal update, an MXU matmul with zero communication.
+
+    Collective budget: ``n_stages`` psums of ``rows_loc * k`` elements —
+    exactly one solved block each, never the operand (asserted by
+    tests/test_linalg_depth HLO budgets). Pad rows are sanitized to identity
+    rows inside the kernel, so their solution is the zero pad of ``b``.
     """
-    from ..tiling import SquareDiagTiles
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
+    dtype = jnp.dtype(dtype_name)
+    n_pad = p * rows_loc
+    owners_arr = jnp.asarray(owners, jnp.int32)
+
+    from ._blocked import sanitize_slab
+
+    def device_fn(Al, bl):
+        idx = jax.lax.axis_index(axis)
+        # pad columns so every diagonal tile is square; pad rows become
+        # identity rows, so their solution is exactly b's zero padding
+        Alp, rows = sanitize_slab(Al, idx, rows_loc, n, n_pad, dtype)
+        rhs0 = jnp.where((rows >= n)[:, None], 0.0, bl.astype(dtype))
+
+        def stage(i, carry):
+            rhs, x_own = carry
+            t = i if lower else (n_stages - 1) - i
+            start = t * rows_loc
+            tile = jax.lax.dynamic_slice(Alp, (0, start), (rows_loc, rows_loc))
+            cand = jax.scipy.linalg.solve_triangular(tile, rhs, lower=lower)
+            is_owner = idx == owners_arr[t]
+            xblk = jax.lax.psum(jnp.where(is_owner, cand, 0.0), axis)
+            x_own = jnp.where(is_owner, xblk, x_own)
+            # off-diagonal fold: subtract this tile-column's contribution
+            # from every local rhs (rows already solved are never re-read)
+            rhs = rhs - tile @ xblk
+            return rhs, x_own
+
+        x0 = jnp.zeros((rows_loc, k), dtype)
+        _, x_own = jax.lax.fori_loop(0, n_stages, stage, (rhs0, x0))
+        return x_own
+
+    sharded = NamedSharding(mesh, P(axis, None))
+
+    @functools.partial(jax.jit, in_shardings=(sharded, sharded), out_shardings=sharded)
+    def run(A_phys, b_pad):
+        return jax.shard_map(
+            device_fn,
+            mesh=mesh,
+            in_specs=(P(axis, None), P(axis, None)),
+            out_specs=P(axis, None),
+            check_vma=False,
+        )(A_phys, b_pad)
+
+    return run
+
+
+def solve_triangular(A: DNDarray, b: DNDarray, lower: bool = False) -> DNDarray:
+    """Solve ``A x = b`` for triangular ``A``.
+
+    Replicated ``A``: one XLA triangular kernel. Split ``A``: a fused
+    shard_map blocked-substitution program whose stage grid and diagonal
+    ownership come from the :class:`~heat_tpu.core.tiling.SquareDiagTiles`
+    decomposition (one tile per device — the runtime's physical ceil-chunk
+    grid; the reference drives its tile-QR from the same decomposition,
+    reference tiling.py:331-1257). One psum of one solved block per stage;
+    the operand is never gathered. A split-1 ``A`` is resharded to split 0
+    first (one alltoall — the column schedule would pay a full-rhs psum per
+    stage instead).
+    """
     if not isinstance(A, DNDarray) or not isinstance(b, DNDarray):
         raise TypeError("A and b must be DNDarrays")
     if A.ndim != 2 or A.shape[0] != A.shape[1]:
@@ -92,28 +163,48 @@ def solve_triangular(A: DNDarray, b: DNDarray, lower: bool = False) -> DNDarray:
     if b.shape[0] != A.shape[0]:
         raise ValueError("b's leading dimension must match A")
 
-    tiles = SquareDiagTiles(A, tiles_per_proc=2)
-    # global tile-row boundaries from the decomposition's index arithmetic
-    bounds = [0] + [int(t) for t in tiles.row_indices[1:]] + [A.shape[0]]
-    bounds = sorted(set(bounds))
+    n = int(A.shape[0])
+    dtype = jnp.result_type(A.larray.dtype, b.larray.dtype, jnp.float32)
 
-    Al = A.larray.astype(jnp.result_type(A.larray.dtype, jnp.float32))
-    bl = b.larray.astype(Al.dtype)
+    if A.split is None or A.comm.size == 1:
+        bl = b.larray.astype(dtype)
+        if vector_rhs:
+            bl = bl[:, None]
+        x = jax.scipy.linalg.solve_triangular(A.larray.astype(dtype), bl, lower=lower)
+        if vector_rhs:
+            x = x[:, 0]
+        out = factories.array(x, device=b.device, comm=b.comm)
+        out.resplit_(b.split)
+        return out
+
+    if A.split == 1:
+        from ..manipulations import resplit as _resplit
+
+        A = _resplit(A, 0)
+
+    comm = A.comm
+    # stage grid + diagonal ownership from the tile decomposition (one tile
+    # per device: the physical grid; shared with det via _blocked.stage_grid)
+    from ._blocked import stage_grid
+
+    p, rows_loc, n_stages, owners = stage_grid(A)
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    bl = b.larray.astype(dtype)
     if vector_rhs:
         bl = bl[:, None]
-    x = jnp.zeros_like(bl)
+    k = int(bl.shape[1])
+    b_pad = jax.device_put(
+        jnp.pad(bl, ((0, p * rows_loc - n), (0, 0))),
+        NamedSharding(comm.mesh, PartitionSpec(comm.axis_name, None)),
+    )
 
-    spans = list(zip(bounds[:-1], bounds[1:]))
-    order = spans if lower else list(reversed(spans))
-    for (s, e) in order:
-        rhs = bl[s:e]
-        if lower:
-            rhs = rhs - Al[s:e, :s] @ x[:s]
-        else:
-            rhs = rhs - Al[s:e, e:] @ x[e:]
-        blk = jax.scipy.linalg.solve_triangular(Al[s:e, s:e], rhs, lower=lower)
-        x = x.at[s:e].set(blk)
-
+    fn = _tri_solve_program(
+        comm.mesh, comm.axis_name, p, n, k, rows_loc, n_stages, owners, bool(lower), dtype.name
+    )
+    x_pad = fn(A.parray, b_pad)
+    x = x_pad[:n]
     if vector_rhs:
         x = x[:, 0]
     out = factories.array(x, device=b.device, comm=b.comm)

@@ -17,6 +17,7 @@ need it. Here both come almost for free from the TPU-native factorizations:
 from __future__ import annotations
 
 import collections
+import functools
 from typing import Optional
 
 import jax.numpy as jnp
@@ -32,24 +33,38 @@ __all__ = ["svd", "lstsq", "pinv"]
 SVD = collections.namedtuple("SVD", "U, S, Vh")
 
 
-def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
+def svd(a: DNDarray, full_matrices: bool = True, compute_uv: bool = True):
     """Singular value decomposition ``a = U @ diag(S) @ Vh``.
 
-    Always reduced (``full_matrices=True`` is rejected — the reference
-    framework has no SVD and the reduced form is what the distributed
-    construction produces without an extra orthogonal completion).
+    The default ``full_matrices=True`` matches ``numpy.linalg.svd`` (and
+    torch) so code ported from either gets the shapes it expects — or a loud
+    ``NotImplementedError`` rather than silently different shapes. The full
+    decomposition is computed locally for replicated operands; for a *split*
+    operand only the reduced form exists (the distributed TSQR construction
+    produces it without an extra orthogonal completion), so pass
+    ``full_matrices=False`` explicitly there.
 
-    Split semantics: a split-0 tall operand yields a split-0 ``U`` and
-    replicated ``S``/``Vh``; a split-1 wide operand the mirror image.
+    Split semantics (reduced form): a split-0 tall operand yields a split-0
+    ``U`` and replicated ``S``/``Vh``; a split-1 wide operand the mirror
+    image.
     """
     sanitation.sanitize_in(a)
     if a.ndim != 2:
         raise ValueError(f"svd requires a 2-D operand, got {a.ndim}-D")
-    if full_matrices:
-        raise NotImplementedError(
-            "svd computes the reduced decomposition; full_matrices=True would "
-            "require completing the orthogonal basis (not supported)"
-        )
+    if full_matrices and compute_uv:
+        if a.split is not None:
+            raise NotImplementedError(
+                "full_matrices=True (the numpy-compatible default) is only "
+                "supported for replicated operands; a split operand's "
+                "distributed construction produces the reduced form — pass "
+                "full_matrices=False explicitly"
+            )
+        local = a.larray
+        if not jnp.issubdtype(local.dtype, jnp.inexact):
+            local = local.astype(basics._float_for(a))  # promote like qr() does
+        u, s, vh = jnp.linalg.svd(local, full_matrices=True)
+        mk = functools.partial(factories.array, device=a.device, comm=a.comm)
+        return SVD(mk(u), mk(s), mk(vh))
     m, n = a.shape
 
     if m < n:
@@ -111,7 +126,7 @@ def pinv(a: DNDarray, rcond: float = 1e-15) -> DNDarray:
     sanitation.sanitize_in(a)
     if a.ndim != 2:
         raise ValueError(f"pinv requires a 2-D operand, got {a.ndim}-D")
-    u, s, vh = svd(a)
+    u, s, vh = svd(a, full_matrices=False)
     s_np = s.larray
     cutoff = rcond * jnp.max(s_np)
     s_inv = jnp.where(s_np > cutoff, 1.0 / jnp.where(s_np > cutoff, s_np, 1.0), 0.0)

@@ -415,11 +415,14 @@ def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     axis = sanitize_axis(a.shape, axis)
     # complex sorts lexicographically through the gather path (no total-order
     # sentinel exists for the ragged pad slots)
-    use_dist = (
-        a.split == axis
-        and a.comm.size > 1
-        and not jnp.issubdtype(a.parray.dtype, jnp.complexfloating)
-    )
+    is_complex = jnp.issubdtype(a.parray.dtype, jnp.complexfloating)
+    use_dist = a.split == axis and a.comm.size > 1 and not is_complex
+    if is_complex and a.split == axis and a.comm.size > 1:
+        sanitation.warn_replicated(
+            "sort",
+            "complex dtypes have no total-order pad sentinel for the "
+            "distributed merge-exchange network; sorting on the gathered view",
+        )
     if use_dist:
         sv, sg = _dist_sort(a, axis, descending)
         # sv/sg leave the program at the padded physical shape, correctly
@@ -495,18 +498,32 @@ def _dist_sort_program(
         v = jnp.take_along_axis(v, order, axis)
         return v, (jnp.take_along_axis(g, order, axis) if g is not None else None)
 
+    # only TWO pairings exist (even rounds pair (0,1)(2,3)…, odd rounds
+    # (1,2)(3,4)…), so the p rounds run as a fori_loop choosing between two
+    # static-perm branches with lax.cond — program size is O(1) in p (the
+    # 64-chip compile-scaling requirement, tests/test_mesh64_compile)
+    def _pairing(parity: int):
+        partner = list(range(p))
+        for lo in range(parity, p - 1, 2):
+            partner[lo], partner[lo + 1] = lo + 1, lo
+        perm = [(d, partner[d]) for d in range(p)]
+        is_lower = jnp.asarray([partner[d] > d for d in range(p)])
+        is_paired = jnp.asarray([partner[d] != d for d in range(p)])
+        return perm, is_lower, is_paired
+
+    pairings = (_pairing(0), _pairing(1))
+
     def body(v, g):
         idx = jax.lax.axis_index(axis_name)
         block = v.shape[axis]
+        has_g = g is not None
         v, g = local_sort(v, g)
-        for r in range(p):
-            partner = list(range(p))
-            for lo in range(r % 2, p - 1, 2):
-                partner[lo], partner[lo + 1] = lo + 1, lo
-            perm = [(d, partner[d]) for d in range(p)]
+
+        def merge_round(carry, perm, lower_vec, paired_vec):
+            v, g = carry  # g is a 0-d dummy when has_g is False
+            is_lower = lower_vec[idx]
+            is_paired = paired_vec[idx]
             pv = jax.lax.ppermute(v, axis_name, perm)
-            is_lower = jnp.asarray([partner[d] > d for d in range(p)])[idx]
-            is_paired = jnp.asarray([partner[d] != d for d in range(p)])[idx]
             # concatenate in global order (lower device's block first) so the
             # stable merge keeps equal keys in global-position order
             cat_v = jnp.concatenate(
@@ -517,7 +534,7 @@ def _dist_sort_program(
             lo_v = jax.lax.slice_in_dim(sv, 0, block, axis=axis)
             hi_v = jax.lax.slice_in_dim(sv, block, 2 * block, axis=axis)
             new_v = jnp.where(is_paired, jnp.where(is_lower, lo_v, hi_v), v)
-            if g is not None:
+            if has_g:
                 pg = jax.lax.ppermute(g, axis_name, perm)
                 cat_g = jnp.concatenate(
                     [jnp.where(is_lower, g, pg), jnp.where(is_lower, pg, g)], axis=axis
@@ -526,8 +543,20 @@ def _dist_sort_program(
                 lo_g = jax.lax.slice_in_dim(sg, 0, block, axis=axis)
                 hi_g = jax.lax.slice_in_dim(sg, block, 2 * block, axis=axis)
                 g = jnp.where(is_paired, jnp.where(is_lower, lo_g, hi_g), g)
-            v = new_v
-        return v, g
+            return new_v, g
+
+        carry0 = (v, g if has_g else jnp.zeros((), v.dtype))
+
+        def round_fn(r, carry):
+            return jax.lax.cond(
+                r % 2 == 0,
+                lambda c: merge_round(c, *pairings[0]),
+                lambda c: merge_round(c, *pairings[1]),
+                carry,
+            )
+
+        v, g_out = jax.lax.fori_loop(0, p, round_fn, carry0)
+        return v, (g_out if has_g else None)
 
     if with_indices:
         kernel = body
@@ -725,6 +754,7 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis
     sanitation.sanitize_in(a)
     if axis is not None:
         axis = sanitize_axis(a.shape, axis)
+    is_complex = jnp.issubdtype(a.parray.dtype, jnp.complexfloating)
     use_dist = (
         axis is None
         and not return_inverse
@@ -732,8 +762,14 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis
         and a.comm.size > 1
         and a.ndim >= 1
         and a.size > 0
-        and not jnp.issubdtype(a.parray.dtype, jnp.complexfloating)
+        and not is_complex
     )
+    if is_complex and axis is None and a.split is not None and a.comm.size > 1:
+        sanitation.warn_replicated(
+            "unique",
+            "complex dtypes have no total-order pad sentinel for the "
+            "distributed sort network; deduplicating on the gathered view",
+        )
     if use_dist:
         flat = ravel(a) if a.ndim > 1 else a
         sv = _dist_sort(flat, 0, False, with_indices=False)

@@ -25,7 +25,26 @@ __all__ = [
     "sanitize_out",
     "sanitize_sequence",
     "scalar_to_1d",
+    "warn_replicated",
 ]
+
+
+class ReplicationWarning(UserWarning):
+    """A distributed operand degraded to a replicated/gathered execution."""
+
+
+def warn_replicated(op: str, reason: str) -> None:
+    """The explicit-fallback policy (the qr.py pattern, qr.py:106-113),
+    shared by every path where a *distributed* operand silently degrades to
+    replicated execution: say so, loudly, exactly once per call site's
+    message. Filterable via :class:`ReplicationWarning`."""
+    import warnings
+
+    warnings.warn(
+        f"heat_tpu.{op}: executing on a REPLICATED operand — {reason}",
+        ReplicationWarning,
+        stacklevel=3,
+    )
 
 
 def sanitize_in(x) -> None:

@@ -29,10 +29,17 @@ __all__ = ["SplitTiles", "SquareDiagTiles"]
 
 def _axis_tile_sizes(length: int, n: int) -> np.ndarray:
     """Block sizes when ``length`` is chunked into ``n`` contiguous blocks
-    (remainder on the lowest tiles — the reference chunk rule,
-    reference communication.py:193-203)."""
-    base, rem = divmod(length, n)
-    return np.array([base + (1 if i < rem else 0) for i in range(n)], dtype=np.int64)
+    under GSPMD's ceil-division rule — the layout this runtime actually
+    places shards with (communication.py:counts_displs_shape), so tile
+    ownership matches physical ownership. (The reference balances the
+    remainder across the lowest ranks instead, reference
+    communication.py:193-203 — an MPI layout this runtime does not use.)"""
+    if n <= 0:
+        return np.zeros(0, dtype=np.int64)
+    block = -(-length // n) if length else 0
+    return np.array(
+        [max(0, min(block, length - i * block)) for i in range(n)], dtype=np.int64
+    )
 
 
 class SplitTiles:

@@ -25,14 +25,17 @@ The feature axis is NOT padded to the 128-lane width in HBM — blocks are
 DMA'd as (block, f) and padded only in VMEM — so the bandwidth advantage
 survives small f (f=16 padded in HBM would octuple the bytes).
 
-Like ops/pairwise.py, the jnp path stays the default until the kernel is
-measured faster on real hardware; today bench.py is the only consumer (the
-``lloyd_fused_iters_per_sec`` field measures it side by side with the jnp
-path). :func:`fused_lloyd_iter` is single-device (its pallas_call has no
-partitioning spec — ``fused_supported`` gates on that);
-:func:`fused_lloyd_iter_sharded` is the multi-chip form: a shard_map
-wrapper running the kernel per device and merging the (k, f) accumulators
-with one psum — the exact collective budget of the jnp path.
+This kernel IS the product path: ``cluster.KMeans.fit`` dispatches here on
+TPU (``fused_supported`` / ``fused_sharded_supported``), keeping the jnp
+path as the fallback and numerical oracle; bench.py's primary kmeans metric
+measures whichever path the product dispatches (``lloyd_path`` in the
+record), with the other path alongside (``lloyd_jnp_iters_per_sec`` /
+``lloyd_fused_vs_jnp``). :func:`fused_lloyd_iter` is
+single-device (its pallas_call has no partitioning spec);
+:func:`fused_lloyd_iter_sharded` / :func:`fused_lloyd_run_sharded` are the
+multi-chip forms: a shard_map wrapper running the kernel per device and
+merging the (k, f) accumulators with one psum — the exact collective budget
+of the jnp path.
 """
 
 from __future__ import annotations
@@ -48,6 +51,8 @@ __all__ = [
     "fused_lloyd_iter",
     "fused_lloyd_iter_sharded",
     "fused_lloyd_run",
+    "fused_lloyd_run_sharded",
+    "fused_sharded_supported",
     "fused_supported",
 ]
 
@@ -67,6 +72,16 @@ def fused_supported(n: int, f: int, k: int) -> bool:
     except Exception:  # pragma: no cover
         return False
     return backend_ok and single and f <= 512 and k <= 128
+
+
+def fused_sharded_supported(f: int, k: int) -> bool:
+    """TPU backend and lane-safe shapes; device count is irrelevant (the
+    shard_map wrapper runs the kernel per device)."""
+    try:
+        backend_ok = jax.default_backend() in ("tpu", "axon")
+    except Exception:  # pragma: no cover
+        return False
+    return backend_ok and f <= 512 and k <= 128
 
 
 def _lloyd_kernel(
@@ -89,7 +104,18 @@ def _lloyd_kernel(
     can carry its own count."""
     i = pl.program_id(0)
 
-    xb = x_ref[:, :]  # (block, f)
+    # 2-D iotas: Mosaic does not lower 1-D iota
+    klane = jax.lax.broadcasted_iota(jnp.int32, (1, k), 1)
+    rows = i * block + jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0)
+    valid_b = rows < nvalid_ref[0, 0]  # (BLOCK, 1) bool
+
+    # Pad-region content is UNSPECIFIED (dndarray.parray contract) — inf/NaN
+    # there would poison the accumulators through 0·inf = NaN in the sums
+    # matmul, so zero invalid rows rather than relying on multiplicative
+    # masking downstream.
+    xb = jnp.where(valid_b, x_ref[:, :], 0)  # (block, f)
+    valid = valid_b.astype(xb.dtype)
+
     # (block, k) assignment scores; |x|² omitted (row-constant for argmin)
     score = csq_ref[:, :] - 2.0 * jnp.dot(
         xb, cT_ref[:, :], preferred_element_type=jnp.float32
@@ -97,10 +123,6 @@ def _lloyd_kernel(
     labels = jnp.argmin(score, axis=1).astype(jnp.int32)  # (block,)
     lab_ref[:, :] = labels[:, None]
 
-    # 2-D iotas: Mosaic does not lower 1-D iota
-    klane = jax.lax.broadcasted_iota(jnp.int32, (1, k), 1)
-    rows = i * block + jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0)
-    valid = (rows < nvalid_ref[0, 0]).astype(xb.dtype)  # (BLOCK, 1)
     onehot = (labels[:, None] == klane).astype(xb.dtype) * valid  # (BLOCK, k)
 
     @pl.when(i == 0)
@@ -113,7 +135,9 @@ def _lloyd_kernel(
         sums_ref.dtype
     )
     counts_ref[:, :] += jnp.sum(onehot, axis=0, dtype=counts_ref.dtype)[None, :]
-    masked_min = jnp.min(score, axis=1) * valid[:, 0].astype(jnp.float32)
+    # where, not multiply: even a finite-but-garbage pad score must not leak,
+    # and NaN·0 = NaN would defeat a multiplicative mask
+    masked_min = jnp.where(valid_b[:, 0], jnp.min(score, axis=1), 0.0)
     inertia_ref[:, :] += jnp.sum(masked_min, dtype=inertia_ref.dtype)[None, None]
 
 
@@ -126,10 +150,11 @@ def _kernel_call(data, centers, k: int, n_valid, interpret: bool):
     counts, inertia) outputs.
     """
     n, f = data.shape
-    csq = jnp.sum(centers * centers, axis=1, dtype=jnp.float32)[None, :]  # (1, k)
-    cT = centers.T.astype(data.dtype)  # (f, k)
-
+    # downcast BEFORE deriving cT so the kernel never mixes f64 operands
+    # (Mosaic cannot lower f64; interpret/CPU would silently promote)
     x = data.astype(jnp.float32) if data.dtype == jnp.float64 else data
+    csq = jnp.sum(centers * centers, axis=1, dtype=jnp.float32)[None, :]  # (1, k)
+    cT = centers.T.astype(x.dtype)  # (f, k)
     block = _block_rows(f)
     n_pad = -(-n // block) * block
     if n_pad != n:
@@ -242,11 +267,10 @@ def fused_lloyd_iter_sharded(
     return fn(data, centers, xsq_sum)
 
 
-@functools.lru_cache(maxsize=None)
-def _sharded_fn(mesh, axis, p, k, n_global, interpret):
-    """Jitted sharded iteration, cached per static config (the
-    attention.py:_ring_attention_fn closure-cache pattern — comm objects are
-    unhashable, their mesh/axis are)."""
+def _sharded_iter_fn(mesh, axis, k, n_global, interpret):
+    """Traced (data, centers, xsq_sum) -> iteration tuple over a row-sharded
+    physical payload — the shared body of the per-iteration and fused-run
+    sharded entry points."""
     from jax.sharding import PartitionSpec as P
 
     def device_step(xl, c):
@@ -259,8 +283,7 @@ def _sharded_fn(mesh, axis, p, k, n_global, interpret):
         inertia = jax.lax.psum(inertia, axis)
         return labels2d[:local_rows], sums, counts, inertia
 
-    @jax.jit
-    def run(data, centers, xsq_sum):
+    def step(data, centers, xsq_sum):
         labels2d, sums, counts, inertia = jax.shard_map(
             device_step,
             mesh=mesh,
@@ -268,12 +291,71 @@ def _sharded_fn(mesh, axis, p, k, n_global, interpret):
             out_specs=(P(axis, None), P(), P(), P()),
             check_vma=False,  # pallas_call outputs carry no vma annotation
         )(data, centers)
-        if xsq_sum is None:
-            # Σ|x|² over the LOGICAL rows only: the physical pad region's
-            # content is unspecified (dndarray.parray contract) — never
-            # fold it into the inertia
-            x32 = data[:n_global].astype(jnp.float32)
-            xsq_sum = jnp.sum(x32 * x32)
         return _finalize(labels2d[:n_global, 0], sums, counts, inertia, centers, xsq_sum)
+
+    return step
+
+
+def _logical_xsq_sum(data, n_global):
+    # Σ|x|² over the LOGICAL rows only: the physical pad region's content is
+    # unspecified (dndarray.parray contract) — never fold it into the inertia
+    x32 = data[:n_global].astype(jnp.float32)
+    return jnp.sum(x32 * x32)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_fn(mesh, axis, p, k, n_global, interpret):
+    """Jitted sharded iteration, cached per static config (the
+    attention.py:_ring_attention_fn closure-cache pattern — comm objects are
+    unhashable, their mesh/axis are)."""
+    step = _sharded_iter_fn(mesh, axis, k, n_global, interpret)
+
+    @jax.jit
+    def run(data, centers, xsq_sum):
+        if xsq_sum is None:
+            xsq_sum = _logical_xsq_sum(data, n_global)
+        return step(data, centers, xsq_sum)
+
+    return run
+
+
+def fused_lloyd_run_sharded(
+    data: jax.Array,
+    centers: jax.Array,
+    k: int,
+    comm,
+    n_global: int,
+    n_steps: int,
+    interpret: bool = False,
+):
+    """``n_steps`` fused sharded iterations in ONE XLA program — the
+    multi-chip analog of :func:`fused_lloyd_run`: Σ|x|² hoisted once, a
+    ``fori_loop`` of single-pass kernel steps, one psum per step."""
+    fn = _sharded_run_fn(
+        comm.mesh, comm.axis_name, comm.size, k, int(n_global), int(n_steps), bool(interpret)
+    )
+    return fn(data, centers)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_run_fn(mesh, axis, p, k, n_global, n_steps, interpret):
+    step = _sharded_iter_fn(mesh, axis, k, n_global, interpret)
+
+    @jax.jit
+    def run(data, centers):
+        xsq_sum = _logical_xsq_sum(data, n_global)
+
+        def body(i, carry):
+            c = carry[0]
+            return step(data, c, xsq_sum)
+
+        acc = jnp.zeros((), jnp.float32)
+        init = (
+            centers.astype(jnp.float32),
+            jnp.zeros(n_global, jnp.int32),
+            acc,
+            acc,
+        )
+        return jax.lax.fori_loop(0, n_steps, body, init)
 
     return run

@@ -172,6 +172,8 @@ def _ring_dist_sym(xl: jax.Array, metric: Callable, comm) -> jax.Array:
     m_block = xl.shape[0] // p
     paired, self_paired = _sym_schedule(p)
 
+    h = len(paired)  # offsets 1..h computed directly; their mirrors arrive
+
     def kernel(xs):
         rank = jax.lax.axis_index(axis)
 
@@ -188,16 +190,46 @@ def _ring_dist_sym(xl: jax.Array, metric: Callable, comm) -> jax.Array:
             pass
         # diagonal tile: local compute, no communication
         out = write(out, metric(xs, xs), rank)
-        ys_cur = xs
-        # unrolled (p is static): each step needs a distinct mirror shift
-        for i in paired:
+
+        # ⌈p/2⌉ uniform shift-1 rotations in a fori_loop (program size O(1)
+        # in p — tests/test_mesh64_compile); each step stashes its tile at
+        # slot (rank+i) % p so ONE all_to_all afterwards hands every device
+        # exactly the mirror tiles of its row, replacing the per-step
+        # variable-shift ppermute the unrolled schedule needed
+        buf0 = jnp.zeros((p, m_block, m_block), dtype=xs.dtype)
+
+        def step(i, carry):
+            ys_cur, out, buf = carry
             ys_cur = comm.ppermute(ys_cur, shift=1)  # now holds shard rank+i
             tile = metric(xs, ys_cur)  # tile (rank, rank+i)
             out = write(out, tile, rank + i)
-            # mirror: device d receives tile (d-i, d) from d-i, transposes it
-            # into tile (d, d-i) — no recompute of the metric
-            recv = comm.ppermute(tile, shift=-i)
-            out = write(out, recv.T, rank - i)
+            slot = (rank + i) % p
+            buf = jax.lax.dynamic_update_slice(
+                buf, tile[None], (slot, jnp.zeros((), slot.dtype), jnp.zeros((), slot.dtype))
+            )
+            return ys_cur, out, buf
+
+        ys_cur, out, buf = jax.lax.fori_loop(1, h + 1, step, (xs, out, buf0))
+
+        if h:
+            # slot j of device d holds tile (d, j) iff (j - d) % p in 1..h;
+            # all_to_all delivers slot j to device j — device r receives
+            # tile (d, r) from every d, i.e. its whole mirror column
+            mirror = jax.lax.all_to_all(buf, axis, split_axis=0, concat_axis=0)
+
+            def fold_mirror(d, out):
+                valid = ((rank - d) % p >= 1) & ((rank - d) % p <= h)
+                col = (d % p) * m_block
+                cur = jax.lax.dynamic_slice(
+                    out, (jnp.zeros((), col.dtype), col), (m_block, m_block)
+                )
+                tile_t = mirror[d].T
+                return jax.lax.dynamic_update_slice(
+                    out, jnp.where(valid, tile_t, cur), (jnp.zeros((), col.dtype), col)
+                )
+
+            out = jax.lax.fori_loop(0, p, fold_mirror, out)
+
         if self_paired:
             # p even: offset p/2 is its own mirror — every device computes it
             ys_cur = comm.ppermute(ys_cur, shift=1)

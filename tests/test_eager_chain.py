@@ -1,0 +1,108 @@
+"""Layout stability across eager op chains + the explicit-fallback warnings.
+
+VERDICT weak-8: single ops are HLO-tested, but layout ping-pong BETWEEN
+chained eager ops (a device_put reshard per op) would pass every per-op
+test. Here a representative 10-op pipeline on a split-0 operand must issue
+ZERO reshard device_puts after the initial placement — every intermediate
+stays on the split it entered with.
+
+Also pins the shared explicit-fallback policy (sanitation.warn_replicated):
+complex split-axis sort/unique announce their gathered execution instead of
+silently degrading (the qr.py:106-113 pattern, now one helper + one warning
+class).
+"""
+
+import unittest.mock
+import warnings
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core.sanitation import ReplicationWarning
+
+from harness import TestCase
+
+
+class TestEagerChainLayout(TestCase):
+    def test_ten_op_chain_zero_reshards(self):
+        p = self.get_size()
+        n = 8 * p
+        a = ht.array(np.random.default_rng(0).standard_normal((n, 4)), split=0)
+        b = ht.array(np.random.default_rng(1).standard_normal((n, 4)), split=0)
+
+        import jax
+
+        real_device_put = jax.device_put
+        calls = []
+
+        def counting_device_put(x, *args, **kwargs):
+            calls.append(getattr(x, "shape", None))
+            return real_device_put(x, *args, **kwargs)
+
+        with unittest.mock.patch.object(jax, "device_put", counting_device_put):
+            c = a + b                # 1  elementwise, same split
+            c = c * 2.0              # 2  scalar broadcast
+            c = ht.exp(c)            # 3  unary local op
+            c = c - b                # 4
+            d = ht.abs(c)            # 5
+            e = d + a                # 6
+            f = ht.sqrt(ht.abs(e))   # 7
+            g = f / (d + 1.0)        # 8
+            h = g * b                # 9
+            total = ht.sum(h)        # 10 reduction (replicated scalar out)
+
+        # the chain's operands all share split=0; no intermediate may bounce
+        # through a reshard. (The scalar result of sum and python-scalar
+        # broadcasts are not (n,·) payload moves.)
+        payload_moves = [s for s in calls if s is not None and len(s) == 2 and s[0] == n]
+        self.assertEqual(
+            payload_moves, [],
+            f"eager chain re-placed full payloads {len(payload_moves)}x: {payload_moves}",
+        )
+        self.assertTrue(np.isfinite(float(total.larray)))
+
+    def test_chain_result_correct(self):
+        # numerical guard for the chain above (mock removed)
+        p = self.get_size()
+        n = 8 * p
+        a_np = np.random.default_rng(0).standard_normal((n, 4))
+        b_np = np.random.default_rng(1).standard_normal((n, 4))
+        a, b = ht.array(a_np, split=0), ht.array(b_np, split=0)
+        c = ht.exp((a + b) * 2.0) - b
+        expect = np.exp((a_np + b_np) * 2.0) - b_np
+        np.testing.assert_allclose(c.numpy(), expect, rtol=1e-6)
+        self.assertEqual(c.split, 0)
+
+
+class TestReplicationWarnings(TestCase):
+    def test_complex_split_sort_warns(self):
+        p = self.get_size()
+        if p == 1:
+            self.skipTest("gather fallback only exists on a distributed mesh")
+        vals = (np.random.default_rng(3).standard_normal((4 * p, 2))).astype(np.complex64)
+        a = ht.array(vals, split=0)
+        with pytest.warns(ReplicationWarning, match="sort"):
+            v, i = ht.sort(a, axis=0)
+        np.testing.assert_allclose(
+            v.numpy(), np.sort(vals, axis=0), rtol=1e-6
+        )
+
+    def test_complex_unique_warns(self):
+        p = self.get_size()
+        if p == 1:
+            self.skipTest("gather fallback only exists on a distributed mesh")
+        vals = np.array([1 + 1j, 1 + 1j, 2 + 0j] * (2 * p), dtype=np.complex64)
+        a = ht.array(vals, split=0)
+        with pytest.warns(ReplicationWarning, match="unique"):
+            u = ht.unique(a)
+        np.testing.assert_allclose(np.sort_complex(u.numpy()), np.unique(vals))
+
+    def test_real_split_sort_does_not_warn(self):
+        p = self.get_size()
+        vals = np.random.default_rng(4).standard_normal(4 * p)
+        a = ht.array(vals, split=0)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", ReplicationWarning)
+            v, _ = ht.sort(a, axis=0)
+        np.testing.assert_allclose(v.numpy(), np.sort(vals), rtol=1e-6)

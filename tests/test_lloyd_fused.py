@@ -1,8 +1,9 @@
 """Fused pallas Lloyd kernel vs the jnp reference implementation.
 
 Runs in pallas interpret mode on CPU (the same strategy as
-tests/test_ops_pallas.py); real-TPU timing lives in bench.py's
-``lloyd_fused_iters_per_sec`` field.
+tests/test_ops_pallas.py); real-TPU timing lives in bench.py's primary
+kmeans metric (``lloyd_path: fused_pallas``) and its ``lloyd_fused_vs_jnp``
+margin field.
 """
 
 import numpy as np
@@ -100,6 +101,41 @@ class TestFusedLloyd(TestCase):
         np.testing.assert_allclose(np.asarray(got_c), np.asarray(ref_c), rtol=1e-5, atol=1e-5)
         np.testing.assert_allclose(float(got_inertia), float(ref_inertia), rtol=1e-4)
         np.testing.assert_allclose(float(got_shift), float(ref_shift), rtol=1e-4, atol=1e-6)
+
+    def test_pad_garbage_does_not_poison_accumulators(self):
+        # dndarray.parray's pad region is UNSPECIFIED: pad-aware elementwise
+        # ops can leave inf/NaN there. Regression for the advisor-verified
+        # bug where 0·inf = NaN leaked through the multiplicative mask into
+        # sums/centers and inertia.
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu.cluster.kmeans import _lloyd_iter
+
+        rng = np.random.default_rng(9)
+        n, f, k = 1000, 4, 3
+        data_np = rng.standard_normal((n, f)).astype(np.float32)
+        centers = jnp.asarray(rng.standard_normal((k, f)).astype(np.float32))
+
+        # simulate garbage tail padding by asking the kernel to mask rows
+        # beyond n while feeding inf/NaN content there
+        poisoned = np.concatenate(
+            [data_np, np.full((24, f), np.inf, np.float32), np.full((8, f), np.nan, np.float32)]
+        )
+        from heat_tpu.ops.lloyd import _kernel_call
+
+        labels2d, sums, counts, inertia = jax.jit(
+            lambda d, c: _kernel_call(d, c, k, jnp.asarray(n, jnp.int32), True)
+        )(jnp.asarray(poisoned), centers)
+        assert np.isfinite(np.asarray(sums)).all()
+        assert np.isfinite(float(inertia[0, 0]))
+
+        ref_c, ref_lab, ref_inertia, _ = jax.jit(_lloyd_iter, static_argnames="k")(
+            jnp.asarray(data_np), centers, k
+        )
+        np.testing.assert_array_equal(np.asarray(labels2d)[:n, 0], np.asarray(ref_lab))
+        got_counts = np.asarray(counts)[0]
+        assert got_counts.sum() == n  # no pad row counted
 
     def test_sharded_wrapper_divisible(self):
         import jax.numpy as jnp

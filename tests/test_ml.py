@@ -102,6 +102,51 @@ class TestCluster(TestCase):
         with pytest.raises(ValueError):
             km.fit(X)
 
+    def test_kmeans_fused_path_matches_jnp(self):
+        # the product fused-pallas dispatch (use_fused=True -> interpret mode
+        # on the CPU mesh): same fixed point and labels as the jnp oracle.
+        # Only split=0 — a replicated operand on a multi-device mesh has no
+        # fused dispatch (the jnp comparison would be oracle-vs-oracle).
+        X, y = make_blobs()
+        for split in (0,):
+            x = ht.array(X, split=split)
+            ref = ht.cluster.KMeans(
+                n_clusters=3, init="kmeans++", max_iter=50, random_state=5, use_fused=False
+            ).fit(x)
+            got = ht.cluster.KMeans(
+                n_clusters=3, init="kmeans++", max_iter=50, random_state=5, use_fused=True
+            ).fit(x)
+            self.assertGreater(_cluster_accuracy(got.labels_.numpy(), y, 3), 0.95)
+            np.testing.assert_array_equal(got.labels_.numpy(), ref.labels_.numpy())
+            np.testing.assert_allclose(
+                got.cluster_centers_.numpy(), ref.cluster_centers_.numpy(), rtol=1e-4, atol=1e-4
+            )
+            np.testing.assert_allclose(got.inertia_, ref.inertia_, rtol=1e-3)
+
+    def test_kmeans_fused_ragged_rows(self):
+        # prime row count: the sharded kernel must mask the physical pad
+        rng = np.random.default_rng(12)
+        X = np.concatenate(
+            [rng.normal(0, 0.3, (101, 3)), rng.normal(4, 0.3, (102, 3))]
+        ).astype(np.float32)
+        y = np.array([0] * 101 + [1] * 102)
+        x = ht.array(X, split=0)
+        km = ht.cluster.KMeans(n_clusters=2, random_state=3, use_fused=True).fit(x)
+        self.assertGreater(_cluster_accuracy(km.labels_.numpy(), y, 2), 0.99)
+        self.assertEqual(km.labels_.shape[0], 203)
+
+    def test_kmeans_forced_fused_unhonorable_warns(self):
+        # use_fused=True with no fused dispatch available must be loud, not
+        # a vacuous pass through the jnp oracle
+        import warnings as _w
+
+        X = np.random.default_rng(14).standard_normal((40, 600)).astype(np.float32)
+        km = ht.cluster.KMeans(n_clusters=2, max_iter=2, random_state=0, use_fused=True)
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            km.fit(ht.array(X, split=0))  # f=600 > 512: no fused dispatch
+        self.assertTrue(any("use_fused=True" in str(x.message) for x in rec))
+
     def test_kmeans_precomputed_init(self):
         X, y = make_blobs()
         init = ht.array(np.array([[0.0, 0.0], [6.0, 6.0], [0.0, 6.0]], dtype=np.float32))

@@ -18,7 +18,7 @@ class TestSVD(TestCase):
         a_np = rng.standard_normal((24, 4)).astype(np.float32)
         for split in (None, 0, 1):
             a = ht.resplit(ht.array(a_np), split)
-            u, s, vh = ht.linalg.svd(a)
+            u, s, vh = ht.linalg.svd(a, full_matrices=False)
             np.testing.assert_allclose(_reconstruct(u, s, vh), a_np, atol=1e-4)
             # singular values match numpy's (descending, non-negative)
             np.testing.assert_allclose(
@@ -35,7 +35,7 @@ class TestSVD(TestCase):
         a_np = rng.standard_normal((3, 17)).astype(np.float32)
         for split in (None, 0, 1):
             a = ht.resplit(ht.array(a_np), split)
-            u, s, vh = ht.linalg.svd(a)
+            u, s, vh = ht.linalg.svd(a, full_matrices=False)
             assert u.shape == (3, 3) and vh.shape == (3, 17)
             np.testing.assert_allclose(_reconstruct(u, s, vh), a_np, atol=1e-4)
 
@@ -50,14 +50,23 @@ class TestSVD(TestCase):
     def test_ragged_rows(self):
         rng = np.random.default_rng(3)
         a_np = rng.standard_normal((13, 3)).astype(np.float32)  # prime rows
-        u, s, vh = ht.linalg.svd(ht.array(a_np, split=0))
+        u, s, vh = ht.linalg.svd(ht.array(a_np, split=0), full_matrices=False)
         np.testing.assert_allclose(_reconstruct(u, s, vh), a_np, atol=1e-4)
 
     def test_validation(self):
         with pytest.raises(ValueError):
             ht.linalg.svd(ht.ones((2, 3, 4)))
         with pytest.raises(NotImplementedError):
-            ht.linalg.svd(ht.ones((4, 3)), full_matrices=True)
+            ht.linalg.svd(ht.ones((4, 3), split=0), full_matrices=True)
+
+    def test_full_matrices_replicated_matches_numpy(self):
+        # numpy-compatible default: replicated operands get the FULL factors
+        rng = np.random.default_rng(7)
+        a_np = rng.standard_normal((6, 4)).astype(np.float32)
+        u, s, vh = ht.linalg.svd(ht.array(a_np))
+        assert u.shape == (6, 6) and s.shape == (4,) and vh.shape == (4, 4)
+        rec = np.asarray(u.larray)[:, :4] @ np.diag(np.asarray(s.larray)) @ np.asarray(vh.larray)
+        np.testing.assert_allclose(rec, a_np, atol=1e-4)
 
 
 class TestLstsq(TestCase):

@@ -65,8 +65,10 @@ class TestSquareDiagTiles:
         a = ht.random.randn(5, 5, split=0)
         n = a.comm.size
         sq = SquareDiagTiles(a, tiles_per_proc=2)
-        slab_sizes = [5 // n + (1 if i < 5 % n else 0) for i in range(n)]
-        starts = np.cumsum([0] + slab_sizes)[:-1]
+        # the RUNTIME layout (GSPMD ceil-division — communication.py
+        # counts_displs_shape), which the tile grid must mirror
+        counts, displs = a.comm.counts_displs_shape((5, 5), 0)
+        starts = np.asarray(displs)
         for i, rstart in enumerate(sq.row_indices):
             expect = int(np.searchsorted(starts, rstart, side="right") - 1)
             assert sq.tile_map[i, 0, 2] == expect

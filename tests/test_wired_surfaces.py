@@ -42,13 +42,26 @@ class TestSolveTriangular(TestCase):
             ht.linalg.solve_triangular(ht.ones((3, 3)), ht.ones(4))
 
     def test_consumes_tiles(self):
+        # the fused solve's stage grid comes from the SquareDiagTiles
+        # decomposition (via linalg._blocked.stage_grid, shared with det)
         import inspect
 
-        from heat_tpu.core.linalg import solver
+        from heat_tpu.core.linalg import _blocked, solver
+        from heat_tpu.core.tiling import SquareDiagTiles
 
         src = inspect.getsource(solver.solve_triangular)
-        self.assertIn("SquareDiagTiles", src)
-        self.assertIn("row_indices", src)
+        self.assertIn("stage_grid", src)
+        helper_src = inspect.getsource(_blocked.stage_grid)
+        self.assertIn("SquareDiagTiles", helper_src)
+        self.assertIn("row_indices", helper_src)
+
+        # behavioral: the grid matches the decomposition's ownership map
+        a = ht.ones((4 * self.get_size() + 1, 4 * self.get_size() + 1), split=0)
+        p, rows_loc, n_stages, owners = _blocked.stage_grid(a)
+        tiles = SquareDiagTiles(a, tiles_per_proc=1)
+        self.assertEqual(n_stages, len(tiles.row_indices))
+        for i, owner in enumerate(owners):
+            self.assertEqual(owner, int(tiles.tile_map[i, min(i, tiles.tile_columns - 1), 2]))
 
 
 class TestCombinerRouting(TestCase):

@@ -263,11 +263,16 @@ class DNDarray:
 
     @property
     def lshape(self) -> Tuple[int, ...]:
-        """Logical shape of the mesh's rank-0 device shard (reference
-        dndarray.py:301 reports the calling rank's local tensor; under a
-        single controller this is the representative chunk — see
-        doc/internals_distribution.md for the multi-host caveats)."""
-        _, lshape, _ = self.__comm.chunk(self.__gshape, self.__split, rank=0)
+        """Logical shape of this process's representative device shard
+        (reference dndarray.py:301 reports the calling rank's local tensor;
+        the analog under one controller per host is the first rank THIS
+        process addresses — multihost.representative_rank — so every host
+        reports a shard it actually holds; contract in
+        doc/internals_distribution.md)."""
+        from .multihost import representative_rank
+
+        rank = representative_rank(self.__comm.devices)
+        _, lshape, _ = self.__comm.chunk(self.__gshape, self.__split, rank=rank)
         return lshape
 
     @property

@@ -132,14 +132,12 @@ def _sharded_ingest(read_block, gshape, dtype, split, device, comm) -> DNDarray:
     pshape[split] = block * p
     counts, displs = comm.counts_displs_shape(gshape, split)
     sharding = comm.sharding(len(gshape), split)
-    try:
-        proc = jax.process_index()
-    except Exception:  # pragma: no cover
-        proc = 0
+    from .multihost import ranks_to_read
+
     arrays = []
-    for r, d in enumerate(comm.devices):
-        if d.process_index != proc:
-            continue  # multi-host: each host reads only its addressable blocks
+    # multi-host: each host reads only its addressable blocks (the seam is
+    # unit-tested against a mocked 2-process topology)
+    for r, d in ranks_to_read(comm.devices):
         sl = [slice(None)] * len(gshape)
         sl[split] = slice(displs[r], displs[r] + counts[r])
         local = np.asarray(read_block(tuple(sl)), dtype=jdt)
@@ -429,10 +427,10 @@ def save_csv(
     encoding: str = "utf-8",
     **kwargs,
 ) -> None:
-    """Save to CSV (reference io.py:926-1059: rank-by-rank serialized writes
-    without a global gather).
+    """Save to CSV, streaming shard blocks in rank order without a gather.
 
-    Split arrays stream shard by shard in rank order — each device's block is
+    The reference serializes rank-by-rank over its token ring
+    (reference io.py:926-1059); split arrays here stream shard by shard — each device's block is
     brought to host and appended on its own (the single-controller edition of
     the reference's token ring); the global array is NEVER materialized. A
     split-1 operand is resharded to rows first (one alltoall — CSV is a

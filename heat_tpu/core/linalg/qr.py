@@ -60,19 +60,27 @@ def qr(
     panel schedules have no tile-count knob and never mutate their input.
 
     ``method``: ``"tsqr"`` (default — Householder-based, unconditionally
-    stable) or ``"cholqr2"`` — CholeskyQR2 for tall-skinny operands: R from
-    ``chol(AᵀA)``, Q by triangular solve, repeated once for re-orthonormal-
-    ization. Every FLOP is a matmul, so on TPU it runs on the MXU where
-    Householder QR is mostly vector work; the price is a squared condition
-    number in the first pass — safe for ``cond(A) ≲ 1/√ε`` (~3e3 f32 /
-    ~7e7 f64), and it raises on detected breakdown (non-finite Cholesky)
-    rather than returning garbage.
+    stable), ``"cholqr2"``, or ``"auto"``. CholeskyQR2 factors tall-skinny
+    operands as R from ``chol(AᵀA)``, Q by triangular solve, repeated once
+    for re-orthonormalization. Every FLOP is a matmul, so on TPU it runs on
+    the MXU where Householder QR is mostly vector work; the price is a
+    squared condition number in the first pass — safe for
+    ``cond(A) ≲ 1/√ε`` (~3e3 f32 / ~7e7 f64), and it raises on detected
+    breakdown (non-finite Cholesky) rather than returning garbage.
+    ``"auto"`` tries the MXU-native CholeskyQR2 first for tall operands and
+    falls back to TSQR on the same breakdown probe instead of raising —
+    the all-matmul speed when conditioning allows, Householder stability
+    when it does not. (TSQR stays the default until a real-TPU capture
+    shows the cholqr2 margin at benchmark shapes — see bench.py's
+    ``qr_cholqr2_tflops`` field.)
     """
     sanitation.sanitize_in(a)
     if a.ndim != 2:
         raise ValueError(f"qr requires a 2-D array, got {a.ndim}-D")
-    if method not in ("tsqr", "cholqr2"):
-        raise ValueError(f"unknown qr method {method!r}: expected 'tsqr' or 'cholqr2'")
+    if method not in ("tsqr", "cholqr2", "auto"):
+        raise ValueError(
+            f"unknown qr method {method!r}: expected 'tsqr', 'cholqr2' or 'auto'"
+        )
     if not types.heat_type_is_inexact(a.dtype):
         a = a.astype(types.promote_types(a.dtype, types.float32))
 
@@ -82,7 +90,14 @@ def qr(
 
     q_split = a.split
     r_split: Optional[int] = None
-    if method == "cholqr2":
+    q_arr = r_arr = None
+    if method == "auto" and m >= n:
+        # try the MXU-native CholeskyQR2, fall back to Householder on the
+        # breakdown probe (ill-conditioned squared-condition first pass)
+        q_try, r_try = _cholqr2_kernel(a.larray, calc_q)
+        if bool(jnp.isfinite(r_try).all()):
+            q_arr, r_arr = q_try, r_try
+    elif method == "cholqr2":
         if m < n:
             raise ValueError(f"cholqr2 requires a tall operand (m >= n), got {a.shape}")
         q_arr, r_arr = _cholqr2_kernel(a.larray, calc_q)
@@ -92,27 +107,30 @@ def qr(
                 "the operand is rank-deficient or too ill-conditioned for the "
                 "squared-condition first pass — use method='tsqr'"
             )
-    # TSQR needs a full (n, n) R per block: block = ceil(m/p) >= n, otherwise
-    # the R-tile all-gather would move p*block*n = the FULL operand volume —
-    # exactly the silent gather the explicit fallback policy exists to avoid
-    elif a.split == 0 and p > 1 and m >= n and -(-m // p) >= n:
-        q_arr, r_arr = _tsqr(a, comm)
-    elif a.split == 1 and p > 1 and m >= n:
-        q_arr, r_arr = _panel_qr_split1(a, comm)
-        r_split = 1
-    else:
-        # replicated or short-wide: one XLA QR kernel over the gathered
-        # operand — explicit policy with a size guard, never silent (the
-        # shared warn_replicated helper so callers can filter one class)
-        if a.is_distributed() and a.size > _REPLICATED_MAX_ELEMENTS:
-            sanitation.warn_replicated(
-                "qr",
-                f"no gather-free distributed schedule for shape {a.shape} "
-                f"split={a.split} (short-wide, or row blocks narrower than "
-                "n); consider resplit or a transpose formulation",
-            )
-        q_arr, r_arr = jnp.linalg.qr(a.larray, mode="reduced")
-        r_split = 1 if a.split == 1 else None
+
+    if r_arr is None:  # no CholeskyQR2 result: Householder dispatch
+        # TSQR needs a full (n, n) R per block: block = ceil(m/p) >= n,
+        # otherwise the R-tile all-gather would move p*block*n = the FULL
+        # operand volume — exactly the silent gather the explicit fallback
+        # policy exists to avoid
+        if a.split == 0 and p > 1 and m >= n and -(-m // p) >= n:
+            q_arr, r_arr = _tsqr(a, comm)
+        elif a.split == 1 and p > 1 and m >= n:
+            q_arr, r_arr = _panel_qr_split1(a, comm)
+            r_split = 1
+        else:
+            # replicated or short-wide: one XLA QR kernel over the gathered
+            # operand — explicit policy with a size guard, never silent (the
+            # shared warn_replicated helper so callers can filter one class)
+            if a.is_distributed() and a.size > _REPLICATED_MAX_ELEMENTS:
+                sanitation.warn_replicated(
+                    "qr",
+                    f"no gather-free distributed schedule for shape {a.shape} "
+                    f"split={a.split} (short-wide, or row blocks narrower than "
+                    "n); consider resplit or a transpose formulation",
+                )
+            q_arr, r_arr = jnp.linalg.qr(a.larray, mode="reduced")
+            r_split = 1 if a.split == 1 else None
 
     r = DNDarray(
         _ensure_split(r_arr, r_split, comm),

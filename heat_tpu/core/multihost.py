@@ -1,0 +1,76 @@
+"""The multi-host seam: every per-process decision in one place.
+
+A 64-chip slice is multi-host: each controller process addresses only its
+own host's devices, and the single-controller idioms ("rank 0's chunk",
+"read every block") become per-host filters. The reference framework gets
+this from MPI ranks (reference communication.py:1886-1891); here the facts
+come from ``jax.process_index``/``device.process_index``, and the handful
+of call sites that must care (sharded ingest, ``lshape``, per-shard saves)
+route through these helpers so the contract is testable against a mocked
+process topology (tests/test_multihost_seam.py) without owning two hosts.
+
+Contract (documented in doc/internals_distribution.md):
+
+* ``process_index()`` — this controller's process id (0 on a single host).
+* ``is_addressable(device)`` — whether this process may transfer to/from
+  the device. Ingest loops skip non-addressable ranks; the global array is
+  assembled with ``make_array_from_single_device_arrays``, which accepts
+  per-host partial shard lists.
+* ``ranks_to_read(devices)`` — the (rank, device) pairs THIS process must
+  populate when ingesting a split array, in rank order.
+* ``representative_rank(devices)`` — the mesh rank whose chunk stands in
+  for "the local shard" in single-array views (``lshape``): the first
+  rank addressable by this process, so every host reports a shard it
+  actually holds.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+
+__all__ = [
+    "process_index",
+    "is_addressable",
+    "ranks_to_read",
+    "representative_rank",
+]
+
+
+def process_index() -> int:
+    """This controller process's id; 0 when the backend has no notion of
+    processes (single host, or an unstarted distributed runtime)."""
+    try:
+        return int(jax.process_index())
+    except Exception:  # pragma: no cover - backend-dependent
+        return 0
+
+
+def is_addressable(device, proc: int | None = None) -> bool:
+    """Whether ``device`` belongs to this process (may be transferred
+    to/from). Devices without a ``process_index`` attribute are treated as
+    addressable — the single-host CPU/TPU cases."""
+    if proc is None:
+        proc = process_index()
+    return getattr(device, "process_index", proc) == proc
+
+
+def ranks_to_read(devices: Sequence, proc: int | None = None) -> List[Tuple[int, object]]:
+    """The (mesh_rank, device) pairs this process must populate when
+    ingesting a split array — its addressable ranks, in rank order."""
+    if proc is None:
+        proc = process_index()
+    return [(r, d) for r, d in enumerate(devices) if is_addressable(d, proc)]
+
+
+def representative_rank(devices: Sequence, proc: int | None = None) -> int:
+    """The mesh rank whose chunk this process reports as "the local shard"
+    (``DNDarray.lshape``): the first addressable rank, falling back to 0
+    when none is (defensive — a controller always owns at least one)."""
+    if proc is None:
+        proc = process_index()
+    for r, d in enumerate(devices):
+        if is_addressable(d, proc):
+            return r
+    return 0  # pragma: no cover - a controller always addresses a device

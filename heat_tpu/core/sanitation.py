@@ -34,10 +34,11 @@ class ReplicationWarning(UserWarning):
 
 
 def warn_replicated(op: str, reason: str) -> None:
-    """The explicit-fallback policy (the qr.py pattern, qr.py:106-113),
-    shared by every path where a *distributed* operand silently degrades to
-    replicated execution: say so, loudly, exactly once per call site's
-    message. Filterable via :class:`ReplicationWarning`."""
+    """Warn that a distributed operand degraded to replicated execution.
+
+    The explicit-fallback policy (the qr.py pattern, qr.py:106-113), shared
+    by every path where a *distributed* operand would otherwise silently
+    gather: say so, loudly. Filterable via :class:`ReplicationWarning`."""
     import warnings
 
     warnings.warn(

@@ -18,10 +18,13 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
 from ..core import factories, sanitation, types
+from ..core.communication import ppermute as _ppermute
 from ..core.dndarray import DNDarray, _ensure_split
 
 __all__ = ["cdist", "manhattan", "rbf"]
@@ -77,6 +80,15 @@ def manhattan(X: DNDarray, Y: Optional[DNDarray] = None, expand: bool = False) -
     return _dist(X, Y, _manhattan)
 
 
+@functools.lru_cache(maxsize=32)
+def _gaussian_metric(sigma: float, fast: bool) -> Callable:
+    """One stable metric closure per (sigma, fast) — a fresh lambda per rbf
+    call would defeat the ring-program caches keyed on the metric object."""
+    if fast:
+        return lambda x, y: _gaussian_fast(x, y, sigma)
+    return lambda x, y: _gaussian(x, y, sigma)
+
+
 def rbf(
     X: DNDarray,
     Y: Optional[DNDarray] = None,
@@ -84,9 +96,7 @@ def rbf(
     quadratic_expansion: bool = False,
 ) -> DNDarray:
     """Pairwise RBF kernel matrix (reference distance.py:176-207)."""
-    if quadratic_expansion:
-        return _dist(X, Y, lambda x, y: _gaussian_fast(x, y, sigma))
-    return _dist(X, Y, lambda x, y: _gaussian(x, y, sigma))
+    return _dist(X, Y, _gaussian_metric(float(sigma), bool(quadratic_expansion)))
 
 
 def _dist(X: DNDarray, Y: Optional[DNDarray], metric: Callable) -> DNDarray:
@@ -165,16 +175,22 @@ def _ring_dist_sym(xl: jax.Array, metric: Callable, comm) -> jax.Array:
     rotations of the stationary operand instead of p−1, recovering the
     reference's symmetry optimization (reference distance.py:272-327) with
     the mirrored tile travelling over the same ICI ring."""
+    return _sym_program(comm.mesh, comm.axis_name, comm.size, metric)(xl)
+
+
+@functools.lru_cache(maxsize=64)
+def _sym_program(mesh, axis: str, p: int, metric: Callable):
+    """Cached jitted symmetric-ring program (one trace per (mesh, metric);
+    jit re-specializes per operand shape internally). Exposed so tests can
+    ``.lower()`` it for HLO collective-budget assertions."""
     from jax.sharding import PartitionSpec as P
 
-    p = comm.size
-    axis = comm.axis_name
-    m_block = xl.shape[0] // p
     paired, self_paired = _sym_schedule(p)
 
     h = len(paired)  # offsets 1..h computed directly; their mirrors arrive
 
     def kernel(xs):
+        m_block = xs.shape[0]  # per-device row block
         rank = jax.lax.axis_index(axis)
 
         def write(out, tile, col_block):
@@ -200,7 +216,7 @@ def _ring_dist_sym(xl: jax.Array, metric: Callable, comm) -> jax.Array:
 
         def step(i, carry):
             ys_cur, out, buf = carry
-            ys_cur = comm.ppermute(ys_cur, shift=1)  # now holds shard rank+i
+            ys_cur = _ppermute(ys_cur, axis, p, shift=1)  # now holds shard rank+i
             tile = metric(xs, ys_cur)  # tile (rank, rank+i)
             out = write(out, tile, rank + i)
             slot = (rank + i) % p
@@ -232,33 +248,35 @@ def _ring_dist_sym(xl: jax.Array, metric: Callable, comm) -> jax.Array:
 
         if self_paired:
             # p even: offset p/2 is its own mirror — every device computes it
-            ys_cur = comm.ppermute(ys_cur, shift=1)
+            ys_cur = _ppermute(ys_cur, axis, p, shift=1)
             out = write(out, metric(xs, ys_cur), rank + p // 2)
         return out
 
-    fn = jax.jit(
+    return jax.jit(
         jax.shard_map(
             kernel,
-            mesh=comm.mesh,
+            mesh=mesh,
             in_specs=P(axis, None),
             out_specs=P(axis, None),
             check_vma=False,
         )
     )
-    return fn(xl)
 
 
 def _ring_dist(xl: jax.Array, yl: jax.Array, metric: Callable, comm) -> jax.Array:
     """Systolic ring: the stationary X shard computes one tile per step while
     Y shards rotate via ppermute (the reference's Send-to-(rank+i) schedule,
     distance.py:272-327, re-expressed as a collective-permute ring)."""
+    return _ring_program(comm.mesh, comm.axis_name, comm.size, metric)(xl, yl)
+
+
+@functools.lru_cache(maxsize=64)
+def _ring_program(mesh, axis: str, p: int, metric: Callable):
+    """Cached jitted general-ring program (one trace per (mesh, metric))."""
     from jax.sharding import PartitionSpec as P
 
-    p = comm.size
-    axis = comm.axis_name
-    m_block = yl.shape[0] // p
-
     def kernel(xs, ys):
+        m_block = ys.shape[0]  # per-device row block of the rotating operand
         rank = jax.lax.axis_index(axis)
 
         def fold(i, ys_cur, out):
@@ -271,7 +289,7 @@ def _ring_dist(xl: jax.Array, yl: jax.Array, metric: Callable, comm) -> jax.Arra
             ys_cur, out = carry
             out = fold(i, ys_cur, out)
             # rotate: receive the next shard from the right neighbor
-            ys_next = comm.ppermute(ys_cur, shift=1)
+            ys_next = _ppermute(ys_cur, axis, p, shift=1)
             return ys_next, out
 
         out0 = jax.lax.pcast(
@@ -281,12 +299,11 @@ def _ring_dist(xl: jax.Array, yl: jax.Array, metric: Callable, comm) -> jax.Arra
         ys_last, out = jax.lax.fori_loop(0, p - 1, body, (ys, out0))
         return fold(jnp.asarray(p - 1), ys_last, out)
 
-    fn = jax.jit(
+    return jax.jit(
         jax.shard_map(
             kernel,
-            mesh=comm.mesh,
+            mesh=mesh,
             in_specs=(P(axis, None), P(axis, None)),
             out_specs=P(axis, None),
         )
     )
-    return fn(xl, yl)

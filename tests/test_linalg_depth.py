@@ -270,3 +270,26 @@ class TestDistributedSolve(TestCase):
             np.testing.assert_allclose(got.numpy(), np.linalg.inv(X), atol=1e-6)
             if split is not None:
                 assert got.split == split
+
+    def test_det_batched_replicated(self):
+        r = np.random.default_rng(30)
+        X = r.standard_normal((3, 5, 5)) + 5 * np.eye(5)
+        got = ht.linalg.det(ht.array(X))
+        np.testing.assert_allclose(np.asarray(got.larray), np.linalg.det(X), rtol=1e-8)
+
+    def test_solve_triangular_complex(self):
+        r = np.random.default_rng(31)
+        n = 12
+        T = np.triu(r.standard_normal((n, n)) + 1j * r.standard_normal((n, n)))
+        T = T + 4 * np.eye(n)
+        B = (r.standard_normal((n, 2)) + 1j * r.standard_normal((n, 2)))
+        expect = np.linalg.solve(T, B)
+        for split in (None, 0):
+            x = ht.linalg.solve_triangular(ht.array(T, split=split), ht.array(B, split=0))
+            np.testing.assert_allclose(x.numpy(), expect, rtol=1e-6, atol=1e-8)
+
+    def test_solve_triangular_int_promotes(self):
+        T = np.triu(np.ones((6, 6), np.int64)) + 3 * np.eye(6, dtype=np.int64)
+        b = np.arange(6, dtype=np.int64)
+        x = ht.linalg.solve_triangular(ht.array(T, split=0), ht.array(b, split=0))
+        np.testing.assert_allclose(x.numpy(), np.linalg.solve(T, b), rtol=1e-8)

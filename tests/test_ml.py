@@ -41,6 +41,41 @@ class TestSpatial(TestCase):
         np.testing.assert_allclose(ds.numpy(), scipy_cdist(a, a), rtol=1e-4, atol=1e-4)
         self.assertEqual(ds.split, 0)
 
+    def test_sym_ring_collective_budget(self):
+        # HLO proof: the symmetric ring's collectives are the shift-1
+        # rotations (one operand block), the ONE all_to_all mirror exchange
+        # (the (p, mb, mb) slot buffer), and nothing sized like the (n, n)
+        # output; fori_loop keeps the instruction count O(1) in p
+        import re
+
+        p = self.get_size()
+        if p == 1:
+            self.skipTest("ring only exists on a distributed mesh")
+        import jax.numpy as jnp
+
+        from heat_tpu.spatial.distance import _sq_euclidian_fast, _sym_program
+
+        comm = self.comm
+        mb, f = 4, 3
+        n = mb * p
+        fn = _sym_program(comm.mesh, comm.axis_name, p, _sq_euclidian_fast)
+        hlo = fn.lower(jnp.zeros((n, f), jnp.float64)).compile().as_text()
+        coll = re.findall(
+            r"(?:all-gather|all-reduce|all-to-all|collective-permute)[^\n]*", hlo
+        )
+        self.assertTrue(coll, "symmetric ring lost its collectives")
+        # start/done pairs and fusion annotations each match a line; the
+        # count is a small constant (11 at p=8), nowhere near O(p)
+        self.assertLessEqual(len(coll), 16, "collective count must not scale with p")
+        budget = p * mb * mb  # the mirror slot buffer (biggest legal move)
+        for line in coll:
+            for shape in re.findall(r"f\d+\[([\d,]+)\]", line):
+                elems = int(np.prod([int(d) for d in shape.split(",")]))
+                self.assertLessEqual(
+                    elems, budget,
+                    f"collective moves more than the mirror buffer: {line[:120]}",
+                )
+
     def test_ring_vs_local_consistency(self):
         # both operands split and divisible -> exercises the ppermute ring
         rng = np.random.default_rng(1)

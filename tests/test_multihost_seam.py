@@ -1,0 +1,89 @@
+"""The multi-host seam (heat_tpu/core/multihost.py) against a MOCKED
+2-process topology — two real hosts are not available in CI, so the
+per-process contract (which ranks a process ingests, which shard stands in
+for "the local shard") is pinned as pure-function behavior plus a spy test
+that the sharded ingest actually routes through the seam."""
+
+import types
+import unittest.mock
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.core import multihost
+
+from harness import TestCase
+
+
+def fake_devices(assignment):
+    """Device stand-ins with just the attribute the seam reads."""
+    return [types.SimpleNamespace(process_index=p, id=i) for i, p in enumerate(assignment)]
+
+
+class TestSeamPureFunctions(TestCase):
+    # 8 mesh ranks over 2 hosts, 4 devices each — the v5e-multi-host shape
+    ASSIGNMENT = [0, 0, 0, 0, 1, 1, 1, 1]
+
+    def test_ranks_to_read_partitions_by_process(self):
+        devs = fake_devices(self.ASSIGNMENT)
+        r0 = multihost.ranks_to_read(devs, proc=0)
+        r1 = multihost.ranks_to_read(devs, proc=1)
+        self.assertEqual([r for r, _ in r0], [0, 1, 2, 3])
+        self.assertEqual([r for r, _ in r1], [4, 5, 6, 7])
+        # the two hosts together cover every rank exactly once
+        self.assertEqual(
+            sorted([r for r, _ in r0] + [r for r, _ in r1]), list(range(8))
+        )
+
+    def test_representative_rank_is_first_addressable(self):
+        devs = fake_devices(self.ASSIGNMENT)
+        self.assertEqual(multihost.representative_rank(devs, proc=0), 0)
+        self.assertEqual(multihost.representative_rank(devs, proc=1), 4)
+
+    def test_interleaved_assignment(self):
+        # pathological interleaving still partitions cleanly
+        devs = fake_devices([0, 1, 0, 1])
+        self.assertEqual([r for r, _ in multihost.ranks_to_read(devs, proc=1)], [1, 3])
+        self.assertEqual(multihost.representative_rank(devs, proc=1), 1)
+
+    def test_devices_without_process_index_are_local(self):
+        devs = [types.SimpleNamespace(id=0), types.SimpleNamespace(id=1)]
+        self.assertTrue(all(multihost.is_addressable(d, proc=0) for d in devs))
+        self.assertEqual(len(multihost.ranks_to_read(devs, proc=0)), 2)
+
+
+class TestSeamConsumers(TestCase):
+    def test_sharded_ingest_routes_through_seam(self):
+        try:
+            import h5py  # noqa: F401
+        except ImportError:
+            self.skipTest("h5py not available")
+        import os
+        import tempfile
+
+        p = self.get_size()
+        data = np.arange(4 * p * 3, dtype=np.float32).reshape(4 * p, 3)
+        with tempfile.TemporaryDirectory() as tmp:
+            path = os.path.join(tmp, "x.h5")
+            import h5py
+
+            with h5py.File(path, "w") as f:
+                f.create_dataset("d", data=data)
+            with unittest.mock.patch.object(
+                multihost, "ranks_to_read", wraps=multihost.ranks_to_read
+            ) as spy:
+                # io imports the symbol lazily from the module, so the
+                # module-attribute patch is what the ingest actually calls
+                x = ht.load_hdf5(path, "d", split=0)
+            self.assertTrue(spy.called, "sharded ingest bypassed the multihost seam")
+            np.testing.assert_array_equal(x.numpy(), data)
+
+    def test_lshape_reports_this_processes_shard(self):
+        p = self.get_size()
+        x = ht.ones((2 * p + 1, 3), split=0)  # ragged: rank 0 holds ceil
+        # single host: representative rank is 0, the ceil chunk
+        self.assertEqual(x.lshape, (-(-(2 * p + 1) // p), 3))
+        # mocked second host of a 2p-rank world: its first addressable rank
+        # holds a different chunk — lshape must follow the seam, not rank 0
+        devs = fake_devices([0] * p + [1] * p)
+        self.assertEqual(multihost.representative_rank(devs, proc=1), p)

@@ -216,6 +216,36 @@ class TestCholQR2(TestCase):
         with pytest.raises(ValueError, match="cholqr2 broke down"):
             ht.linalg.qr(ht.array(a_np, split=0), method="cholqr2")
 
+    def test_auto_uses_cholqr2_when_well_conditioned(self):
+        rng = np.random.default_rng(22)
+        a_np = rng.standard_normal((48, 4)).astype(np.float32)
+        a = ht.array(a_np, split=0)
+        q, r = ht.linalg.qr(a, method="auto")
+        q_np, r_np = np.asarray(q.larray), np.asarray(r.larray)
+        np.testing.assert_allclose(q_np.T @ q_np, np.eye(4), atol=2e-4)
+        np.testing.assert_allclose(q_np @ r_np, a_np, atol=2e-4)
+        # auto must pick cholqr2 here: its R diagonal is positive by
+        # construction (Cholesky factors), while TSQR signs are arbitrary
+        assert (np.diag(r_np) > 0).all()
+
+    def test_auto_falls_back_on_breakdown(self):
+        # rank-1: cholqr2 breaks down; auto must return valid TSQR factors
+        # instead of raising
+        col = np.arange(24, dtype=np.float32)[:, None]
+        a_np = np.concatenate([col, col + 0.001, col - 0.001], axis=1)
+        a_np[0] += np.array([1e-4, -1e-4, 2e-4], np.float32)
+        q, r = ht.linalg.qr(ht.array(a_np, split=0), method="auto")
+        np.testing.assert_allclose(
+            np.asarray(q.larray) @ np.asarray(r.larray), a_np, atol=1e-3
+        )
+
+    def test_auto_short_wide_goes_householder(self):
+        a_np = np.random.default_rng(23).standard_normal((3, 9)).astype(np.float32)
+        q, r = ht.linalg.qr(ht.array(a_np), method="auto")
+        np.testing.assert_allclose(
+            np.asarray(q.larray) @ np.asarray(r.larray), a_np, atol=1e-4
+        )
+
     def test_validation(self):
         with pytest.raises(ValueError, match="tall operand"):
             ht.linalg.qr(ht.ones((3, 8)), method="cholqr2")

@@ -67,12 +67,14 @@ def qr(
     squared condition number in the first pass — safe for
     ``cond(A) ≲ 1/√ε`` (~3e3 f32 / ~7e7 f64), and it raises on detected
     breakdown (non-finite Cholesky) rather than returning garbage.
-    ``"auto"`` tries the MXU-native CholeskyQR2 first for tall operands and
-    falls back to TSQR on the same breakdown probe instead of raising —
-    the all-matmul speed when conditioning allows, Householder stability
-    when it does not. (TSQR stays the default until a real-TPU capture
-    shows the cholqr2 margin at benchmark shapes — see bench.py's
-    ``qr_cholqr2_tflops`` field.)
+    ``"auto"`` tries the MXU-native CholeskyQR2 first for genuinely
+    tall-skinny operands (``m >= 2n``, Gram small enough to replicate,
+    split != 1 — the panel path's split-1 R layout must not depend on
+    conditioning) and falls back to TSQR on the same breakdown probe
+    instead of raising — the all-matmul speed when conditioning allows,
+    Householder stability when it does not. (TSQR stays the default until a
+    real-TPU capture shows the cholqr2 margin at benchmark shapes — see
+    bench.py's ``qr_cholqr2_tflops`` field.)
     """
     sanitation.sanitize_in(a)
     if a.ndim != 2:
@@ -91,7 +93,19 @@ def qr(
     q_split = a.split
     r_split: Optional[int] = None
     q_arr = r_arr = None
-    if method == "auto" and m >= n:
+    if (
+        method == "auto"
+        # genuinely tall-skinny only: the probe factors a REPLICATED (n, n)
+        # Gram, so a large square operand would silently replicate — the
+        # exact degradation class warn_replicated polices. The aspect bound
+        # also keeps the probe where CholeskyQR2's all-matmul profile wins.
+        and m >= 2 * n
+        and n * n <= _REPLICATED_MAX_ELEMENTS
+        # split=1 stays on the panel path: its R is split=1 by contract, and
+        # a conditioning-dependent layout flip (replicated R on probe
+        # success) would break layout-dependent callers intermittently
+        and a.split != 1
+    ):
         # try the MXU-native CholeskyQR2, fall back to Householder on the
         # breakdown probe (ill-conditioned squared-condition first pass)
         q_try, r_try = _cholqr2_kernel(a.larray, calc_q)

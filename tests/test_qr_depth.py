@@ -239,6 +239,35 @@ class TestCholQR2(TestCase):
             np.asarray(q.larray) @ np.asarray(r.larray), a_np, atol=1e-3
         )
 
+    def test_auto_square_skips_cholqr2_probe(self):
+        # a square (or insufficiently tall) operand must NOT run the probe:
+        # its (n, n) Gram would be a silent full-size replication
+        import importlib
+        import unittest.mock
+
+        qr_mod = importlib.import_module("heat_tpu.core.linalg.qr")
+
+        a_np = np.random.default_rng(24).standard_normal((12, 12)).astype(np.float32)
+        with unittest.mock.patch.object(
+            qr_mod, "_cholqr2_kernel",
+            side_effect=AssertionError("auto probed a non-tall operand"),
+        ):
+            q, r = ht.linalg.qr(ht.array(a_np, split=0), method="auto")
+        np.testing.assert_allclose(
+            np.asarray(q.larray) @ np.asarray(r.larray), a_np, atol=1e-4
+        )
+
+    def test_auto_split1_keeps_panel_layout(self):
+        # split=1 R layout must not depend on conditioning: auto always
+        # routes the panel path there (R split=1 by contract)
+        p = self.get_size()
+        if p == 1:
+            self.skipTest("panel layout only exists on a distributed mesh")
+        a_np = np.random.default_rng(25).standard_normal((8 * p, 2 * p)).astype(np.float32)
+        q, r = ht.linalg.qr(ht.array(a_np, split=1), method="auto")
+        assert r.split == 1
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a_np, atol=1e-3)
+
     def test_auto_short_wide_goes_householder(self):
         a_np = np.random.default_rng(23).standard_normal((3, 9)).astype(np.float32)
         q, r = ht.linalg.qr(ht.array(a_np), method="auto")

@@ -26,6 +26,7 @@ from ..dndarray import DNDarray, _ensure_split
 from ..stride_tricks import sanitize_axis
 
 __all__ = [
+    "cholesky",
     "cross",
     "det",
     "dot",
@@ -255,6 +256,137 @@ def _det_program(mesh, axis, p, n, rows_loc, n_stages, owners, dtype_name):
         )(A_phys)
 
     return run
+
+
+@functools.lru_cache(maxsize=None)
+def _cholesky_program(mesh, axis, p, n, rows_loc, n_stages, owners, dtype_name):
+    """Fused distributed Cholesky (beyond the reference): right-looking
+    blocked factorization as ONE shard_map program, sharing the det/solve
+    scaffolding (stage grid from SquareDiagTiles, pad rows sanitized to
+    identity — their factor column is the identity block, sliced off).
+
+    Stage ``t``: the diagonal owner factors its updated ``(b, b)`` tile; ONE
+    psum replicates ``L_tt``; every device forms its panel block
+    ``C_i = W_i[:, t] @ L_tt^-T`` (zero above the diagonal), ONE all_gather
+    assembles the block column, and the trailing matrix update
+    ``W -= C @ col^T`` is a local MXU matmul — already-factored columns see
+    only zero contributions, so no masking of the update is needed.
+
+    Collective budget per stage: one ``(b, b)`` psum + one block-column
+    all_gather (``p*b*b`` elements) — never the operand.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    dtype = jnp.dtype(dtype_name)
+    n_pad = p * rows_loc
+    owners_arr = jnp.asarray(owners, jnp.int32)
+
+    def device_fn(Al):
+        from ._blocked import sanitize_slab
+
+        idx = jax.lax.axis_index(axis)
+        W, _ = sanitize_slab(Al, idx, rows_loc, n, n_pad, dtype)
+        L = jnp.zeros_like(W)
+
+        def stage(t, carry):
+            W, L = carry
+            start = t * rows_loc
+            D = jax.lax.dynamic_slice(W, (0, start), (rows_loc, rows_loc))
+            is_owner = idx == owners_arr[t]
+            # numpy convention: ONLY the lower triangle is read. The owner's
+            # diagonal tile may carry arbitrary upper-triangle content (and,
+            # after stage updates, modified upper garbage) — mirror its
+            # lower triangle before factoring. Subdiagonal tiles are pure
+            # lower-triangle data and are used as-is.
+            Dsym = jnp.tril(D) + jnp.tril(D, -1).T
+            Ltt = jnp.linalg.cholesky(Dsym)
+            Ltt = jax.lax.psum(jnp.where(is_owner, Ltt, 0.0), axis)
+            # panel: C_i = W_i[:, t] L_tt^-T for subdiagonal devices; the
+            # owner's block is L_tt itself (no solve against its own tile,
+            # whose upper triangle is unspecified)
+            C = jax.lax.linalg.triangular_solve(
+                Ltt, D, left_side=False, lower=True, transpose_a=True
+            )
+            C = jnp.where(is_owner, Ltt, C)
+            C = jnp.where(idx >= owners_arr[t], C, 0.0)
+            L = jax.lax.dynamic_update_slice(L, C, (0, start))
+            col = jax.lax.all_gather(C, axis).reshape(n_pad, rows_loc)
+            W = W - C @ col.T
+            return W, L
+
+        _, L = jax.lax.fori_loop(0, n_stages, stage, (W, L))
+        return L
+
+    sharded = NamedSharding(mesh, P(axis, None))
+
+    @functools.partial(jax.jit, in_shardings=(sharded,), out_shardings=sharded)
+    def run(A_phys):
+        return jax.shard_map(
+            device_fn,
+            mesh=mesh,
+            in_specs=(P(axis, None),),
+            out_specs=P(axis, None),
+            check_vma=False,
+        )(A_phys)
+
+    return run
+
+
+def cholesky(a: DNDarray) -> DNDarray:
+    """Lower-triangular Cholesky factor of an SPD matrix, ``a = L @ L^T``
+    (beyond the reference).
+
+    Follows numpy exactly: ONLY the lower triangle is read (a matrix stored
+    lower-triangle-only factors identically to its symmetric completion —
+    note JAX's own ``jnp.linalg.cholesky`` instead symmetrizes the full
+    input), and a non-positive-definite operand raises
+    ``numpy.linalg.LinAlgError``. Distributed split operands run a fused
+    right-looking blocked program (:func:`_cholesky_program` — one small
+    psum + one block-column all_gather per stage, operand never gathered);
+    replicated operands take the local XLA kernel.
+    """
+    sanitation.sanitize_in(a)
+    if a.ndim != 2 or a.shape[0] != a.shape[1]:
+        raise ValueError("cholesky requires a square 2-D matrix")
+    is_complex = jnp.issubdtype(a.larray.dtype, jnp.complexfloating)
+    if a.split is not None and a.comm.size > 1 and is_complex:
+        sanitation.warn_replicated(
+            "cholesky", "the blocked program's panel solve is real-only; "
+            "computing the Hermitian factorization on the gathered operand"
+        )
+    if a.split is not None and a.comm.size > 1 and not is_complex:
+        from ._blocked import stage_grid
+
+        if a.split == 1:
+            from ..manipulations import resplit as _resplit
+
+            af = _resplit(a, 0)
+        else:
+            af = a
+        comm = af.comm
+        n = int(af.shape[0])
+        p, rows_loc, n_stages, owners = stage_grid(af)
+        fn = _cholesky_program(
+            comm.mesh, comm.axis_name, p, n, rows_loc, n_stages, owners,
+            jnp.dtype(_float_for(af)).name,
+        )
+        L_pad = fn(af.parray)
+        # pad rows factor as identity; slice the logical block
+        L = L_pad[:n, :n]
+        if not bool(jnp.isfinite(jnp.diagonal(L)).all()):
+            raise np.linalg.LinAlgError("cholesky: matrix is not positive definite")
+        out = _wrap_like(L, a.split, a)
+        return out
+    # numpy reads only the lower triangle; mirror it explicitly because the
+    # XLA kernel would symmetrize the FULL input instead
+    local = a.larray.astype(_float_for(a))
+    lower = jnp.tril(local)
+    strict = jnp.tril(local, -1)
+    sym = lower + (jnp.conjugate(strict).mT if jnp.iscomplexobj(local) else strict.mT)
+    result = jnp.linalg.cholesky(sym)
+    if not bool(jnp.isfinite(result).all()):
+        raise np.linalg.LinAlgError("cholesky: matrix is not positive definite")
+    return _wrap_like(result, a.split, a)
 
 
 def det(a: DNDarray) -> DNDarray:

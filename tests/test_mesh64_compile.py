@@ -118,6 +118,17 @@ def build_det():
 
 timed("det", build_det)
 
+# --- fused distributed cholesky ------------------------------------------
+from heat_tpu.core.linalg.basics import _cholesky_program
+
+def build_cholesky():
+    fn = _cholesky_program(
+        comm.mesh, comm.axis_name, p, 2 * p, 2, p, tuple(range(p)), "float32"
+    )
+    return fn.lower(jnp.zeros((2 * p, 2 * p), jnp.float32)).compile().as_text()
+
+timed("cholesky", build_cholesky)
+
 print(json.dumps(out))
 """
 
@@ -143,13 +154,13 @@ class TestMesh64Compile(unittest.TestCase):
         cls.out = json.loads(proc.stdout.strip().splitlines()[-1])
 
     def test_all_programs_compiled(self):
-        for name in ("panel_qr", "sort", "exscan", "ring_sym", "tri_solve", "det"):
+        for name in ("panel_qr", "sort", "exscan", "ring_sym", "tri_solve", "det", "cholesky"):
             self.assertIn(f"{name}_compile_s", self.out, f"{name} did not compile")
 
     def test_compile_times_bounded(self):
         # generous bound per program on a loaded CI box; the failure mode
         # being guarded (O(p)+ unrolled programs) costs minutes, not seconds
-        for name in ("panel_qr", "sort", "exscan", "ring_sym", "tri_solve", "det"):
+        for name in ("panel_qr", "sort", "exscan", "ring_sym", "tri_solve", "det", "cholesky"):
             self.assertLess(
                 self.out[f"{name}_compile_s"], 120.0,
                 f"{name} compile time blew up at mesh 64: {self.out}",
@@ -164,6 +175,7 @@ class TestMesh64Compile(unittest.TestCase):
             ("exscan", 6),
             ("tri_solve", 6),
             ("det", 8),
+            ("cholesky", 8),
         ):
             self.assertLessEqual(
                 self.out[f"{name}_collective_ops"], bound,

@@ -20,6 +20,18 @@ from typing import Tuple
 import jax.numpy as jnp
 
 
+def mirror_triangle(local, uplo: str = "L"):
+    """Symmetric/Hermitian completion from ONE triangle — numpy's
+    convention for cholesky/eigh (JAX's kernels average the two triangles
+    instead, a silent divergence for one-triangle-stored operands)."""
+    if uplo == "L":
+        tri, strict = jnp.tril(local), jnp.tril(local, -1)
+    else:
+        tri, strict = jnp.triu(local), jnp.triu(local, 1)
+    mirrored = jnp.conjugate(strict).mT if jnp.iscomplexobj(local) else strict.mT
+    return tri + mirrored
+
+
 def stage_grid(a) -> Tuple[int, int, int, tuple]:
     """``(p, rows_loc, n_stages, owners)`` for a split-0 2-D operand.
 

@@ -379,10 +379,9 @@ def cholesky(a: DNDarray) -> DNDarray:
         return out
     # numpy reads only the lower triangle; mirror it explicitly because the
     # XLA kernel would symmetrize the FULL input instead
-    local = a.larray.astype(_float_for(a))
-    lower = jnp.tril(local)
-    strict = jnp.tril(local, -1)
-    sym = lower + (jnp.conjugate(strict).mT if jnp.iscomplexobj(local) else strict.mT)
+    from ._blocked import mirror_triangle
+
+    sym = mirror_triangle(a.larray.astype(_float_for(a)), "L")
     result = jnp.linalg.cholesky(sym)
     if not bool(jnp.isfinite(result).all()):
         raise np.linalg.LinAlgError("cholesky: matrix is not positive definite")

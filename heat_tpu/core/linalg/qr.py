@@ -243,9 +243,10 @@ def _panel_program(mesh, axis: str, m: int, c: int, n: int, p: int, dtype_name: 
             rdd = bcast(rd_own, d)  # (c, c)
             later = idx > d
             # two-pass block Gram-Schmidt (CGS2) of later panels against qd
-            coef1 = qd.T @ a_cur  # (c, c)
+            qdh = jnp.conjugate(qd).mT  # Q^H: correct for complex panels too
+            coef1 = qdh @ a_cur  # (c, c)
             a_upd = a_cur - qd @ coef1
-            coef2 = qd.T @ a_upd
+            coef2 = qdh @ a_upd
             a_upd = a_upd - qd @ coef2
             a_cur = jnp.where(later, a_upd, a_cur)
             # R rows d*c:(d+1)*c of this device's column block

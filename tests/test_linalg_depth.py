@@ -400,3 +400,115 @@ class TestDistributedSolve(TestCase):
 
         with pytest.raises(np.linalg.LinAlgError):
             ht.linalg.cholesky(ht.array(-np.eye(8), split=0))
+
+
+class TestSolveEigh(TestCase):
+    """numpy.linalg.solve / eigh / eigvalsh parity (beyond the reference)."""
+
+    def test_solve_matches_numpy(self):
+        r = np.random.default_rng(80)
+        for n in (12, 17):
+            A = r.standard_normal((n, n)) + n * np.eye(n)
+            for b_shape in ((n,), (n, 3)):
+                b = r.standard_normal(b_shape)
+                expect = np.linalg.solve(A, b)
+                for sa in (None, 0, 1):
+                    x = ht.linalg.solve(ht.array(A, split=sa), ht.array(b, split=0))
+                    np.testing.assert_allclose(
+                        x.numpy(), expect, rtol=1e-5, atol=1e-7,
+                        err_msg=f"n={n} split={sa} b={b_shape}",
+                    )
+                    assert x.shape == b_shape
+
+    def test_solve_validation(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            ht.linalg.solve(ht.ones((3, 4)), ht.ones(3))
+        with pytest.raises(ValueError):
+            ht.linalg.solve(ht.ones((3, 3)), ht.ones(4))
+        with pytest.raises(TypeError):
+            ht.linalg.solve(np.eye(3), ht.ones(3))
+
+    def test_eigh_matches_numpy_and_reads_one_triangle(self):
+        r = np.random.default_rng(81)
+        n = 10
+        B = r.standard_normal((n, n))
+        S = B @ B.T + n * np.eye(n)
+        lower_only = np.tril(S)
+        w_np, v_np = np.linalg.eigh(lower_only)  # numpy reads L triangle
+        res = ht.linalg.eigh(ht.array(lower_only))
+        np.testing.assert_allclose(np.asarray(res.eigenvalues.larray), w_np, rtol=1e-8)
+        # eigenvectors up to sign
+        np.testing.assert_allclose(
+            np.abs(np.asarray(res.eigenvectors.larray)), np.abs(v_np), atol=1e-6
+        )
+        # UPLO="U": upper triangle read
+        upper_only = np.triu(S)
+        w_u = ht.linalg.eigvalsh(ht.array(upper_only), UPLO="U")
+        np.testing.assert_allclose(
+            np.asarray(w_u.larray), np.linalg.eigvalsh(upper_only, UPLO="U"), rtol=1e-8
+        )
+
+    def test_eigh_distributed_warns(self):
+        import pytest
+
+        if self.get_size() == 1:
+            self.skipTest("fallback only exists on a distributed mesh")
+        from heat_tpu.core.sanitation import ReplicationWarning
+
+        S = np.eye(8) * np.arange(1, 9)
+        with pytest.warns(ReplicationWarning, match="eig"):
+            w = ht.linalg.eigvalsh(ht.array(S, split=0))
+        np.testing.assert_allclose(np.asarray(w.larray), np.arange(1, 9.0), rtol=1e-10)
+
+    def test_solve_complex_distributed(self):
+        # Q^H (not Q^T) in the distributed path; panel CGS2 conjugates
+        r = np.random.default_rng(82)
+        n = 9
+        A = (r.standard_normal((n, n)) + 1j * r.standard_normal((n, n))) + n * np.eye(n)
+        b = r.standard_normal(n) + 1j * r.standard_normal(n)
+        expect = np.linalg.solve(A, b)
+        for sa in (None, 0, 1):
+            x = ht.linalg.solve(ht.array(A, split=sa), ht.array(b, split=0))
+            np.testing.assert_allclose(
+                x.numpy(), expect, rtol=1e-5, atol=1e-7, err_msg=f"split={sa}"
+            )
+
+    def test_qr_complex_split1_panel(self):
+        p = self.get_size()
+        if p == 1:
+            self.skipTest("panel path only exists on a distributed mesh")
+        r = np.random.default_rng(83)
+        m, n = 8 * p, 2 * p
+        A = (r.standard_normal((m, n)) + 1j * r.standard_normal((m, n)))
+        q, rr = ht.linalg.qr(ht.array(A, split=1))
+        qn, rn = q.numpy(), rr.numpy()
+        np.testing.assert_allclose(qn @ rn, A, atol=1e-8)
+        np.testing.assert_allclose(qn.conj().T @ qn, np.eye(n), atol=1e-8)
+
+    def test_solve_singular_raises(self):
+        import pytest
+
+        for split in (None, 0):
+            with pytest.raises(np.linalg.LinAlgError):
+                ht.linalg.solve(ht.array(np.zeros((6, 6)), split=split), ht.ones(6))
+
+    def test_solve_split0_stays_distributed(self):
+        # square split-0 must reshard to the panel path, never silently
+        # gather (the explicit-fallback policy)
+        import warnings as _w
+
+        p = self.get_size()
+        if p == 1:
+            self.skipTest("distribution only exists on a multi-device mesh")
+        r = np.random.default_rng(84)
+        n = 4 * p
+        A = r.standard_normal((n, n)) + n * np.eye(n)
+        b = r.standard_normal(n)
+        with _w.catch_warnings():
+            from heat_tpu.core.sanitation import ReplicationWarning
+
+            _w.simplefilter("error", ReplicationWarning)  # any gather -> fail
+            x = ht.linalg.solve(ht.array(A, split=0), ht.array(b, split=0))
+        np.testing.assert_allclose(A @ x.numpy(), b, atol=1e-6)

@@ -11,6 +11,7 @@ transpose is a metadata permutation plus one resharding collective.
 
 from __future__ import annotations
 
+import collections
 import functools
 from typing import List, Optional, Sequence, Tuple, Union
 
@@ -30,6 +31,8 @@ __all__ = [
     "cross",
     "det",
     "dot",
+    "matrix_rank",
+    "slogdet",
     "inv",
     "matmul",
     "matrix_norm",
@@ -222,36 +225,39 @@ def _det_program(mesh, axis, p, n, rows_loc, n_stages, owners, dtype_name):
         W, _ = sanitize_slab(Al, idx, rows_loc, n, n_pad, dtype)  # pad rows: det 1
 
         def stage(i, carry):
-            W, neg, logabs = carry
+            W, neg, zero, logabs = carry
             start = i * rows_loc
             is_owner = idx == owners_arr[i]
             D = jax.lax.dynamic_slice(W, (0, start), (rows_loc, rows_loc))
             s, la = jnp.linalg.slogdet(D)
             neg = neg + jnp.where(is_owner & (s < 0), 1.0, 0.0)
+            zero = zero + jnp.where(is_owner & (s == 0), 1.0, 0.0)
             logabs = logabs + jnp.where(is_owner, la, 0.0)
             B = jnp.linalg.solve(D, W)
             B = jax.lax.psum(jnp.where(is_owner, B, 0.0), axis)
             C = jax.lax.dynamic_slice(W, (0, start), (rows_loc, rows_loc))
             W = jnp.where(is_owner, W, W - C @ B)
-            return W, neg, logabs
+            return W, neg, zero, logabs
 
-        _, neg, logabs = jax.lax.fori_loop(
-            0, n_stages, stage, (W, jnp.zeros((), dtype), jnp.zeros((), dtype))
-        )
+        z = jnp.zeros((), dtype)
+        _, neg, zero, logabs = jax.lax.fori_loop(0, n_stages, stage, (W, z, z, z))
         neg = jax.lax.psum(neg, axis)  # total count of negative pivot-signs
+        zero = jax.lax.psum(zero, axis)  # any exactly-singular pivot => sign 0
         logabs = jax.lax.psum(logabs, axis)
         sign = jnp.where(jnp.mod(neg, 2.0) > 0.5, -1.0, 1.0).astype(dtype)
-        return sign * jnp.exp(logabs)
+        sign = jnp.where(zero > 0, 0.0, sign)
+        return sign, logabs
 
     sharded = NamedSharding(mesh, P(axis, None))
+    rep = NamedSharding(mesh, P())
 
-    @functools.partial(jax.jit, in_shardings=(sharded,), out_shardings=NamedSharding(mesh, P()))
+    @functools.partial(jax.jit, in_shardings=(sharded,), out_shardings=(rep, rep))
     def run(A_phys):
         return jax.shard_map(
             device_fn,
             mesh=mesh,
             in_specs=(P(axis, None),),
-            out_specs=P(),
+            out_specs=(P(), P()),
             check_vma=False,
         )(A_phys)
 
@@ -388,54 +394,116 @@ def cholesky(a: DNDarray) -> DNDarray:
     return _wrap_like(result, a.split, a)
 
 
+def _slogdet_core(a: DNDarray, op: str):
+    """Shared det/slogdet dispatch: run the fused blocked-elimination
+    program when a distributed real path exists, else None (caller takes
+    the replicated kernel). Every fallback announces itself:
+    complex split operands (the sign-parity accumulator is real-only — a
+    complex slogdet sign is a phase, not ±1) and garbage from a singular
+    non-final diagonal tile both warn through the shared policy.
+    Returns ``(sign, logabs)`` — an exactly-singular final Schur block is
+    the valid ``(0, -inf)``."""
+    sanitation.sanitize_in(a)
+    if a.ndim < 2 or a.shape[-1] != a.shape[-2]:
+        raise ValueError("Last two dimensions of the array must be square")
+    if not (a.ndim == 2 and a.split is not None and a.comm.size > 1):
+        return None
+    if jnp.issubdtype(a.larray.dtype, jnp.complexfloating):
+        sanitation.warn_replicated(
+            op, "complex determinants have no sign-parity encoding in the "
+            "blocked-elimination program; computing on the gathered operand"
+        )
+        return None
+    from ._blocked import stage_grid
+
+    if a.split == 1:
+        from ..manipulations import resplit as _resplit
+
+        af = _resplit(a, 0)
+    else:
+        af = a
+    comm = af.comm
+    n = int(af.shape[0])
+    p, rows_loc, n_stages, owners = stage_grid(af)
+    fn = _det_program(
+        comm.mesh, comm.axis_name, p, n, rows_loc, n_stages, owners,
+        jnp.dtype(_float_for(af)).name,
+    )
+    sign, logabs = fn(af.parray)
+    singular_exact = bool((sign == 0) & (logabs == -jnp.inf))
+    if bool(jnp.isfinite(logabs)) or singular_exact:
+        return sign, logabs
+    sanitation.warn_replicated(
+        op, "a diagonal tile was singular under blocked elimination "
+        "(no cross-tile pivoting); falling back to the replicated LU kernel"
+    )
+    return None
+
+
 def det(a: DNDarray) -> DNDarray:
     """Determinant (reference basics.py:160-245: distributed elimination).
 
     Distributed 2-D split operands run the fused blocked-elimination program
-    (:func:`_det_program` — one psum'd pivot-slab broadcast per stage, the
-    operand never gathered). A non-finite outcome (singular diagonal tile —
-    the no-cross-tile-pivoting caveat) falls back to the replicated XLA LU
-    kernel WITH a warning. Replicated/batched operands take the local kernel
-    directly.
+    (:func:`_det_program` via the :func:`_slogdet_core` dispatch shared with
+    :func:`slogdet` — one psum'd pivot-slab broadcast per stage, the operand
+    never gathered); complex/singular-tile/batched/replicated cases take the
+    local XLA kernel, warning where a split operand degrades.
     """
-    sanitation.sanitize_in(a)
-    if a.ndim < 2 or a.shape[-1] != a.shape[-2]:
-        raise ValueError("Last two dimensions of the array must be square")
-    is_complex = jnp.issubdtype(a.larray.dtype, jnp.complexfloating)
-    if a.ndim == 2 and a.split is not None and a.comm.size > 1 and is_complex:
-        # the sign-parity accumulator is real-only math (a complex slogdet
-        # sign is a phase, not ±1) — explicit replicated fallback
-        sanitation.warn_replicated(
-            "det", "complex determinants have no sign-parity encoding in the "
-            "blocked-elimination program; computing on the gathered operand"
-        )
-    if a.ndim == 2 and a.split is not None and a.comm.size > 1 and not is_complex:
-        from ._blocked import stage_grid
-
-        if a.split == 1:
-            from ..manipulations import resplit as _resplit
-
-            af = _resplit(a, 0)
-        else:
-            af = a
-        comm = af.comm
-        n = int(af.shape[0])
-        p, rows_loc, n_stages, owners = stage_grid(af)
-        fn = _det_program(
-            comm.mesh, comm.axis_name, p, n, rows_loc, n_stages, owners,
-            jnp.dtype(_float_for(af)).name,
-        )
-        result = fn(af.parray)
-        if bool(jnp.isfinite(result)):
-            return _wrap_like(result, None, a)
-        from ..sanitation import warn_replicated
-
-        warn_replicated(
-            "det", "a diagonal tile was singular under blocked elimination "
-            "(no cross-tile pivoting); falling back to the replicated LU kernel"
-        )
+    core = _slogdet_core(a, "det")
+    if core is not None:
+        sign, logabs = core
+        return _wrap_like(sign * jnp.exp(logabs), None, a)
     result = jnp.linalg.det(a.larray.astype(_float_for(a)))
     return _wrap_like(result, None, a)
+
+
+SlogdetResult = collections.namedtuple("SlogdetResult", "sign, logabsdet")
+
+
+def slogdet(a: DNDarray) -> "SlogdetResult":
+    """Sign and log|det| (beyond the reference, ``numpy.linalg.slogdet``
+    parity) — the overflow-free determinant for large operands.
+
+    Distributed real 2-D operands read (sign, log|det|) straight out of the
+    blocked-elimination program's accumulators (the :func:`_slogdet_core`
+    dispatch shared with :func:`det`, which is exactly
+    ``sign * exp(logabsdet)`` of this); complex and fallback cases take the
+    local XLA kernel, warning for split operands per the explicit policy.
+    """
+    core = _slogdet_core(a, "slogdet")
+    if core is not None:
+        sign, logabs = core
+        return SlogdetResult(_wrap_like(sign, None, a), _wrap_like(logabs, None, a))
+    sign, logabs = jnp.linalg.slogdet(a.larray.astype(_float_for(a)))
+    return SlogdetResult(_wrap_like(sign, None, a), _wrap_like(logabs, None, a))
+
+
+def matrix_rank(a: DNDarray, tol=None, hermitian: bool = False) -> DNDarray:
+    """Rank from singular values (beyond the reference,
+    ``numpy.linalg.matrix_rank`` parity: default
+    ``tol = max(m, n) * eps * max(S)``).
+
+    Singular values come from the framework's own construction — the
+    distributed TSQR-based :func:`~heat_tpu.core.linalg.svd.svd` for split
+    2-D operands (``hermitian=True`` uses the replicated symmetric
+    eigensolver with the shared replication policy).
+    """
+    sanitation.sanitize_in(a)
+    if a.ndim != 2:
+        raise ValueError("matrix_rank requires a 2-D operand")
+    if hermitian:
+        from .solver import eigvalsh
+
+        s_arr = jnp.abs(eigvalsh(a).larray)
+    else:
+        from .svd import svd as _svd
+
+        s_arr = _svd(a, compute_uv=False).larray
+    if tol is None:
+        eps = jnp.finfo(s_arr.dtype).eps
+        tol = max(int(a.shape[0]), int(a.shape[1])) * eps * jnp.max(s_arr)
+    rank = jnp.sum(s_arr > tol).astype(jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+    return _wrap_like(rank, None, a)
 
 
 def inv(a: DNDarray) -> DNDarray:

@@ -512,3 +512,38 @@ class TestSolveEigh(TestCase):
             _w.simplefilter("error", ReplicationWarning)  # any gather -> fail
             x = ht.linalg.solve(ht.array(A, split=0), ht.array(b, split=0))
         np.testing.assert_allclose(A @ x.numpy(), b, atol=1e-6)
+
+    def test_slogdet_matches_numpy(self):
+        r = np.random.default_rng(90)
+        for n in (12, 17):
+            X = r.standard_normal((n, n)) - 2 * np.eye(n)  # mixed-sign dets
+            es, el = np.linalg.slogdet(X)
+            for split in (None, 0, 1):
+                sres = ht.linalg.slogdet(ht.array(X, split=split))
+                np.testing.assert_allclose(float(sres.sign.larray), es, rtol=1e-8)
+                np.testing.assert_allclose(float(sres.logabsdet.larray), el, rtol=1e-6)
+
+    def test_slogdet_no_overflow_large_scale(self):
+        # the whole point: det overflows f64 around n ~ 200 for n*I; the
+        # log form must stay finite and exact
+        p = self.get_size()
+        n = 32 * p
+        X = 10.0 * np.eye(n)
+        sres = ht.linalg.slogdet(ht.array(X, split=0))
+        np.testing.assert_allclose(float(sres.sign.larray), 1.0)
+        np.testing.assert_allclose(float(sres.logabsdet.larray), n * np.log(10.0), rtol=1e-10)
+
+    def test_matrix_rank_full_and_deficient(self):
+        r = np.random.default_rng(91)
+        A = r.standard_normal((20, 5))
+        for split in (None, 0):
+            got = int(ht.linalg.matrix_rank(ht.array(A, split=split)).larray)
+            assert got == 5
+        # rank deficient: duplicate columns
+        B = np.concatenate([A[:, :3], A[:, :2]], axis=1)
+        got = int(ht.linalg.matrix_rank(ht.array(B, split=0)).larray)
+        assert got == np.linalg.matrix_rank(B) == 3
+        # hermitian path
+        S = A.T @ A
+        got_h = int(ht.linalg.matrix_rank(ht.array(S), hermitian=True).larray)
+        assert got_h == 5

@@ -31,6 +31,7 @@ __all__ = [
     "cross",
     "det",
     "dot",
+    "einsum",
     "matrix_rank",
     "slogdet",
     "inv",
@@ -181,6 +182,68 @@ def vecdot(
         elif not keepdims and split > ax:
             split -= 1
     return _wrap_like(result, split, x1)
+
+
+def einsum(subscripts: str, *operands, optimize: Union[bool, str] = "optimal", out=None) -> DNDarray:
+    """Einstein summation over the subscripts-string form of
+    ``numpy.einsum`` (beyond the reference).
+
+    Executes one sharded ``jnp.einsum`` over the logical global views — XLA
+    GSPMD schedules the contraction collectives, exactly as for
+    :func:`matmul`. The output split is inferred by following each operand's
+    split-axis LABEL into the output subscript: a surviving label keeps the
+    distribution, a contracted one yields a replicated result (the psum
+    case). Ellipsis subscripts compute correctly but return replicated
+    (batch-label tracking through ``...`` is not implemented), and numpy's
+    interleaved sublist calling form is not supported. ``optimize`` is
+    accepted for source compatibility (contraction-path search is XLA's job
+    under jit; the value is forwarded to ``jnp.einsum``).
+    """
+    if out is not None:
+        raise NotImplementedError("einsum does not support out= buffers")
+    if not isinstance(subscripts, str):
+        raise TypeError(
+            "einsum requires the subscripts string as the first argument "
+            "(the interleaved operand/sublist form is not supported)"
+        )
+    arrays = []
+    ref = None
+    for op in operands:
+        if isinstance(op, DNDarray):
+            arrays.append(op.larray)
+            ref = ref if ref is not None else op
+        else:
+            arrays.append(jnp.asarray(op))
+    if ref is None:
+        raise TypeError("einsum requires at least one DNDarray operand")
+    result = jnp.einsum(subscripts, *arrays, optimize=optimize)
+
+    split: Optional[int] = None
+    spec = subscripts.replace(" ", "")
+    if "..." not in spec:
+        if "->" in spec:
+            in_spec, out_spec = spec.split("->")
+        else:
+            in_spec = spec
+            # numpy's implicit-output rule: labels appearing exactly once,
+            # alphabetically
+            labels = in_spec.replace(",", "")
+            out_spec = "".join(sorted(c for c in set(labels) if labels.count(c) == 1))
+        in_specs = in_spec.split(",")
+        if len(in_specs) == len(operands):
+            for op, labels in zip(operands, in_specs):
+                if (
+                    isinstance(op, DNDarray)
+                    and op.split is not None
+                    and op.split < len(labels)
+                ):
+                    lbl = labels[op.split]
+                    if lbl in out_spec:
+                        split = out_spec.index(lbl)
+                        break
+    if split is not None and (result.ndim == 0 or split >= result.ndim):
+        split = None
+    return _wrap_like(result, split, ref)
 
 
 def cross(
@@ -478,10 +541,12 @@ def slogdet(a: DNDarray) -> "SlogdetResult":
     return SlogdetResult(_wrap_like(sign, None, a), _wrap_like(logabs, None, a))
 
 
-def matrix_rank(a: DNDarray, tol=None, hermitian: bool = False) -> DNDarray:
-    """Rank from singular values (beyond the reference,
-    ``numpy.linalg.matrix_rank`` parity: default
-    ``tol = max(m, n) * eps * max(S)``).
+def matrix_rank(a: DNDarray, tol=None, hermitian: bool = False, rtol=None) -> DNDarray:
+    """Rank of a 2-D operand from its singular values (the
+    ``numpy.linalg.matrix_rank`` contract for single matrices: default
+    ``tol = max(m, n) * eps * max(S)``; ``rtol`` scales ``max(S)``
+    directly; numpy's STACKED ndim>2 form is not supported — the singular
+    values come from the distributed 2-D construction).
 
     Singular values come from the framework's own construction — the
     distributed TSQR-based :func:`~heat_tpu.core.linalg.svd.svd` for split
@@ -490,7 +555,12 @@ def matrix_rank(a: DNDarray, tol=None, hermitian: bool = False) -> DNDarray:
     """
     sanitation.sanitize_in(a)
     if a.ndim != 2:
-        raise ValueError("matrix_rank requires a 2-D operand")
+        raise ValueError(
+            "matrix_rank requires a 2-D operand (numpy's stacked ndim>2 form "
+            "is not supported)"
+        )
+    if tol is not None and rtol is not None:
+        raise ValueError("tol and rtol cannot both be given")
     if hermitian:
         from .solver import eigvalsh
 
@@ -499,7 +569,9 @@ def matrix_rank(a: DNDarray, tol=None, hermitian: bool = False) -> DNDarray:
         from .svd import svd as _svd
 
         s_arr = _svd(a, compute_uv=False).larray
-    if tol is None:
+    if tol is None and rtol is not None:
+        tol = rtol * jnp.max(s_arr)
+    elif tol is None:
         eps = jnp.finfo(s_arr.dtype).eps
         tol = max(int(a.shape[0]), int(a.shape[1])) * eps * jnp.max(s_arr)
     rank = jnp.sum(s_arr > tol).astype(jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)

@@ -359,12 +359,15 @@ class TestRoutedKernels(TestCase):
         self.assertIn("all_gather(r1", src)
 
     def test_ring_dist_uses_helpers(self):
+        # the ring programs rotate via the SHARED communication.ppermute
+        # helper (one place owns the ring-rotation semantics)
         import inspect
 
         from heat_tpu.spatial import distance as dist_mod
 
         src = inspect.getsource(dist_mod)
-        self.assertIn("comm.ppermute", src)
+        self.assertIn("from ..core.communication import ppermute", src)
+        self.assertIn("_ppermute(", src)
 
 
 class TestReshardSchedule(TestCase):

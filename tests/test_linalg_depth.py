@@ -547,3 +547,71 @@ class TestSolveEigh(TestCase):
         S = A.T @ A
         got_h = int(ht.linalg.matrix_rank(ht.array(S), hermitian=True).larray)
         assert got_h == 5
+
+
+class TestEinsum(TestCase):
+    """numpy.einsum parity with split inference (beyond the reference)."""
+
+    def test_matmul_contraction_split_inference(self):
+        r = np.random.default_rng(95)
+        A = r.standard_normal((16, 6))
+        B = r.standard_normal((6, 10))
+        expect = A @ B
+        got = ht.einsum("ij,jk->ik", ht.array(A, split=0), ht.array(B))
+        np.testing.assert_allclose(got.numpy(), expect, atol=1e-10)
+        assert got.split == 0  # i survives: row split carries
+        got2 = ht.einsum("ij,jk->ik", ht.array(A, split=1), ht.array(B, split=0))
+        np.testing.assert_allclose(got2.numpy(), expect, atol=1e-10)
+        assert got2.split is None  # j contracted: psum case
+
+    def test_trace_reduction_and_transpose(self):
+        r = np.random.default_rng(96)
+        X = r.standard_normal((9, 9))
+        tr = ht.einsum("ii->", ht.array(X, split=0))
+        np.testing.assert_allclose(float(tr.larray), np.trace(X), atol=1e-10)
+        t = ht.einsum("ij->ji", ht.array(X, split=0))
+        np.testing.assert_allclose(t.numpy(), X.T, atol=1e-12)
+        assert t.split == 1  # i moved to output position 1
+
+    def test_batch_and_outer(self):
+        r = np.random.default_rng(97)
+        A = r.standard_normal((4, 5, 6))
+        B = r.standard_normal((4, 6, 3))
+        got = ht.einsum("bij,bjk->bik", ht.array(A, split=0), ht.array(B, split=0))
+        np.testing.assert_allclose(got.numpy(), np.einsum("bij,bjk->bik", A, B), atol=1e-10)
+        assert got.split == 0
+        u, v = r.standard_normal(8), r.standard_normal(5)
+        outer = ht.einsum("i,j->ij", ht.array(u, split=0), ht.array(v))
+        np.testing.assert_allclose(outer.numpy(), np.outer(u, v), atol=1e-12)
+        assert outer.split == 0
+
+    def test_implicit_output_and_mixed_operands(self):
+        r = np.random.default_rng(98)
+        A = r.standard_normal((7, 4))
+        B = r.standard_normal((4, 9))
+        got = ht.einsum("ij,jk", ht.array(A, split=0), B)  # implicit ->ik
+        np.testing.assert_allclose(got.numpy(), A @ B, atol=1e-10)
+        assert got.split == 0
+
+    def test_ellipsis_computes_replicated(self):
+        r = np.random.default_rng(99)
+        A = r.standard_normal((3, 5, 4))
+        got = ht.einsum("...ij->...ji", ht.array(A, split=0))
+        np.testing.assert_allclose(got.numpy(), np.einsum("...ij->...ji", A), atol=1e-12)
+        assert got.split is None  # documented: no batch-label tracking
+
+    def test_ragged_split_operand(self):
+        p = self.get_size()
+        r = np.random.default_rng(101)
+        A = r.standard_normal((2 * p + 1, 5))  # ragged rows
+        B = r.standard_normal((5, 4))
+        got = ht.einsum("ij,jk->ik", ht.array(A, split=0), ht.array(B))
+        np.testing.assert_allclose(got.numpy(), A @ B, atol=1e-10)
+
+    def test_validation(self):
+        import pytest
+
+        with pytest.raises(TypeError):
+            ht.einsum(np.eye(2), np.eye(2))
+        with pytest.raises(TypeError):
+            ht.einsum("ij,jk->ik", np.eye(2), np.eye(2))  # no DNDarray operand

@@ -22,7 +22,15 @@ from .attention import (
     ulysses_attention,
 )
 from .data_parallel import DataParallel, DataParallelMultiGPU
-from .models import MLP, ResNet, ResNet18, ResNet50, SimpleCNN
+from .models import (
+    MLP,
+    ResNet,
+    ResNet18,
+    ResNet50,
+    SimpleCNN,
+    TransformerBlock,
+    TransformerLM,
+)
 
 import flax.linen as _linen
 
@@ -34,6 +42,8 @@ __all__ = [
     "ResNet",
     "ResNet18",
     "ResNet50",
+    "TransformerBlock",
+    "TransformerLM",
     "models",
     "attention",
     "MultiHeadAttention",

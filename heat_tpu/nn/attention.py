@@ -397,6 +397,11 @@ class MultiHeadAttention(fnn.Module):
     causal: bool = False
     backend: str = "dense"
     dtype: Optional[jnp.dtype] = None
+    # direct kernel injection, overriding ``backend``: a callable
+    # (q, k, v, causal=...) -> out, e.g. functools.partial(ring_attention,
+    # comm=comm). One hook owns the backend plumbing for every consumer
+    # (TransformerBlock composes this module rather than re-implementing it).
+    attention_fn: Optional[Callable] = None
 
     @fnn.compact
     def __call__(self, x, comm: Optional[MeshCommunication] = None):
@@ -409,10 +414,13 @@ class MultiHeadAttention(fnn.Module):
         q = dense(features=qkv_shape, name="query")(x)
         k = dense(features=qkv_shape, name="key")(x)
         v = dense(features=qkv_shape, name="value")(x)
-        attn = _resolve_backend(self.backend)
         kwargs = {"causal": self.causal}
-        if self.backend in ("ring", "ulysses"):
-            kwargs["comm"] = comm
+        if self.attention_fn is not None:
+            attn = self.attention_fn  # comm, scale etc. bound by the caller
+        else:
+            attn = _resolve_backend(self.backend)
+            if self.backend in ("ring", "ulysses"):
+                kwargs["comm"] = comm
         o = attn(q, k, v, **kwargs)
         return fnn.DenseGeneral(
             features=x.shape[-1], axis=(-2, -1), dtype=self.dtype, name="out"

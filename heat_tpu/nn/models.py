@@ -14,7 +14,17 @@ from typing import Any, Callable, Sequence, Tuple
 import flax.linen as fnn
 import jax.numpy as jnp
 
-__all__ = ["MLP", "SimpleCNN", "ResNet", "ResNet18", "ResNet50", "BasicBlock", "Bottleneck"]
+__all__ = [
+    "MLP",
+    "SimpleCNN",
+    "ResNet",
+    "ResNet18",
+    "ResNet50",
+    "BasicBlock",
+    "Bottleneck",
+    "TransformerBlock",
+    "TransformerLM",
+]
 
 
 class MLP(fnn.Module):
@@ -134,3 +144,89 @@ def ResNet18(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
 
 def ResNet50(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
     return ResNet(stage_sizes=(3, 4, 6, 3), block=Bottleneck, num_classes=num_classes, dtype=dtype)
+
+
+class TransformerBlock(fnn.Module):
+    """Pre-norm transformer block (attention + MLP, residual both).
+
+    The attention callable is INJECTED so the same module runs dense
+    single-chip (the default, ``nn.attention.dot_product_attention``) or
+    sequence-parallel over a mesh (pass ``nn.attention.ring_attention`` /
+    ``ulysses_attention`` partials) — long-context execution is a deployment
+    choice, not a different model. Head dims stay in MXU-friendly multiples;
+    no data-dependent control flow.
+    """
+
+    dim: int
+    heads: int = 4
+    mlp_ratio: int = 4
+    causal: bool = True
+    dtype: Any = jnp.float32
+    attention_fn: Any = None  # (q, k, v, causal=...) -> out; default dense
+
+    @fnn.compact
+    def __call__(self, x):  # x: [batch, seq, dim]
+        from .attention import MultiHeadAttention
+
+        h = fnn.LayerNorm(dtype=self.dtype)(x)
+        # qkv/backed-attention/out plumbing lives in ONE module —
+        # MultiHeadAttention — with the kernel injected through its hook
+        out = MultiHeadAttention(
+            num_heads=self.heads,
+            qkv_features=self.dim,
+            causal=self.causal,
+            dtype=self.dtype,
+            attention_fn=self.attention_fn,
+        )(h)
+        x = x + out
+        h = fnn.LayerNorm(dtype=self.dtype)(x)
+        h = fnn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype)(h)
+        h = fnn.gelu(h)
+        x = x + fnn.Dense(self.dim, dtype=self.dtype)(h)
+        return x
+
+
+class TransformerLM(fnn.Module):
+    """Decoder-only language model (embeddings + N blocks + tied-untied head).
+
+    The flagship long-context model family: with ``attention_fn`` left at
+    the dense default it is the single-chip forward the driver
+    compile-checks; with ring/Ulysses attention injected per block the
+    attention contraction runs sequence-parallel over the mesh — O(S/p)
+    per-chip ATTENTION memory (no S x S score matrix is ever materialized;
+    the surrounding Dense/LayerNorm activations stay [B, S, dim] unless the
+    caller shards them with pjit/sharding constraints).
+    """
+
+    vocab: int = 256
+    dim: int = 128
+    depth: int = 2
+    heads: int = 4
+    max_len: int = 2048
+    causal: bool = True
+    dtype: Any = jnp.float32
+    attention_fn: Any = None
+
+    @fnn.compact
+    def __call__(self, tokens):  # tokens: [batch, seq] int
+        if tokens.shape[1] > self.max_len:
+            # jnp gather CLAMPS out-of-bounds indices — over-length input
+            # would silently reuse the last positional row instead of failing
+            raise ValueError(
+                f"sequence length {tokens.shape[1]} exceeds max_len {self.max_len}"
+            )
+        x = fnn.Embed(self.vocab, self.dim, dtype=self.dtype)(tokens)
+        pos = fnn.Embed(self.max_len, self.dim, dtype=self.dtype)(
+            jnp.arange(tokens.shape[1])[None, :]
+        )
+        x = x + pos
+        for _ in range(self.depth):
+            x = TransformerBlock(
+                dim=self.dim,
+                heads=self.heads,
+                causal=self.causal,
+                dtype=self.dtype,
+                attention_fn=self.attention_fn,
+            )(x)
+        x = fnn.LayerNorm(dtype=self.dtype)(x)
+        return fnn.Dense(self.vocab, dtype=jnp.float32)(x)

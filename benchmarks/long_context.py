@@ -35,11 +35,18 @@ def main() -> None:
     args = parser.parse_args()
 
     if args.platform == "cpu":
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                f"{flags} --xla_force_host_platform_device_count={args.devices}".strip()
-            )
+        # an explicit --devices always wins: strip any pre-set count rather
+        # than silently running on a different topology than requested
+        import re
+
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            "",
+            os.environ.get("XLA_FLAGS", ""),
+        ).strip()
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={args.devices}".strip()
+        )
 
     import jax
 
@@ -116,12 +123,13 @@ def main() -> None:
             rec["dense_skipped"] = f"score matrix would be {score_bytes / 1e9:.1f} GB"
         rec["score_matrix_gb_if_dense"] = round(score_bytes / 1e9, 3)
         doc["series"].append(rec)
+        # bank incrementally: a tunnel death during the NEXT (bigger) seq
+        # must not lose this one's measurements (the bench.py pattern)
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(json.dumps(doc, indent=1) + "\n")
 
-    out = json.dumps(doc, indent=1)
-    print(out)
-    if args.out:
-        with open(args.out, "w") as fh:
-            fh.write(out + "\n")
+    print(json.dumps(doc, indent=1))
 
 
 if __name__ == "__main__":

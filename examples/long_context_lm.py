@@ -1,0 +1,68 @@
+"""Long-context language model: train dense, run inference sequence-parallel.
+
+The TransformerLM's attention kernel is injected, so ONE set of parameters
+serves both deployments: a data-parallel training step with dense attention
+(short sequences), then a sequence-parallel forward with ring attention over
+the whole mesh — the attention contraction never materializes the S x S
+score matrix, so per-chip attention memory is O(S/p).
+
+Run: python examples/long_context_lm.py  (any backend; uses all devices)
+"""
+
+import functools
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import heat_tpu as ht
+    from heat_tpu.nn import DataParallel, TransformerLM
+    from heat_tpu.nn.attention import ring_attention
+
+    comm = ht.get_comm()
+    p = comm.size
+    vocab, dim, depth, heads = 64, 32, 2, 4
+    S_train, S_long = 16, 16 * p  # inference sequence grows with the mesh
+
+    # --- train with dense attention over the data-parallel axis ----------
+    model = TransformerLM(vocab=vocab, dim=dim, depth=depth, heads=heads, max_len=S_long)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, vocab, (4 * p, S_train))
+
+    def next_token_loss(logits, labels):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], labels[:, 1:]
+        ).mean()
+
+    dp = DataParallel(model, optimizer=optax.adam(1e-2), loss_fn=next_token_loss)
+    dp.init(0, toks[:2])
+    for step in range(8):
+        loss = dp.train_step(toks, toks)
+        if step % 4 == 0:
+            print(f"step {step}: loss {loss:.4f}")
+
+    # --- same parameters, sequence-parallel long-context forward ---------
+    sp_model = TransformerLM(
+        vocab=vocab, dim=dim, depth=depth, heads=heads, max_len=S_long,
+        attention_fn=functools.partial(ring_attention, comm=comm),
+    )
+    long_toks = jnp.asarray(rng.integers(0, vocab, (1, S_long)))
+    logits = sp_model.apply(dp.params, long_toks)
+    dense_logits = model.apply(dp.params, long_toks)
+    drift = float(jnp.max(jnp.abs(logits - dense_logits)))
+    print(f"sequence-parallel forward at S={S_long} over {p} device(s); "
+          f"max drift vs dense: {drift:.2e}")
+    assert drift < 1e-3
+    print("ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,7 @@ SMOKE_SCRIPTS = [
     "svd_pca",
     "nn_mnist_style",
     "daso_training",
+    "long_context_lm",
 ]
 
 

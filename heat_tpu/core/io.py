@@ -47,16 +47,19 @@ except ImportError:  # pragma: no cover
 
 __CSV_EXTENSION = frozenset([".csv"])
 __NETCDF_EXTENSIONS = frozenset([".nc", ".nc4", ".netcdf"])
+__NPY_EXTENSION = frozenset([".npy"])
 
 __all__ = [
     "load",
     "load_csv",
     "load_hdf5",
     "load_netcdf",
+    "load_npy",
     "save",
     "save_csv",
     "save_hdf5",
     "save_netcdf",
+    "save_npy",
     "supports_hdf5",
     "supports_netcdf",
 ]
@@ -80,6 +83,8 @@ def load(path: str, *args, **kwargs) -> DNDarray:
     extension = os.path.splitext(path)[-1].strip().lower()
     if extension in __CSV_EXTENSION:
         return load_csv(path, *args, **kwargs)
+    if extension in __NPY_EXTENSION:
+        return load_npy(path, *args, **kwargs)
     if extension in __HDF5_EXTENSIONS:
         if not supports_hdf5():
             raise RuntimeError("hdf5 is required for file extension {}".format(extension))
@@ -98,6 +103,8 @@ def save(data: DNDarray, path: str, *args, **kwargs) -> None:
     extension = os.path.splitext(path)[-1].strip().lower()
     if extension in __CSV_EXTENSION:
         return save_csv(data, path, *args, **kwargs)
+    if extension in __NPY_EXTENSION:
+        return save_npy(data, path, *args, **kwargs)
     if extension in __HDF5_EXTENSIONS:
         if not supports_hdf5():
             raise RuntimeError("hdf5 is required for file extension {}".format(extension))
@@ -210,6 +217,26 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
         _write_h5_dataset(handle, dataset, data, **kwargs)
 
 
+def _rank_ordered_blocks(data: DNDarray):
+    """Yield ``(rank, trimmed_block)`` for every addressable shard of a SPLIT
+    array, in rank order — the shard/trim protocol shared by every streaming
+    writer (HDF5 hyperslabs, CSV rows, npy buffers): each physical shard is
+    cut back to its logical extent (pad+mask contract) and handed over one
+    host transfer at a time, never a global gather."""
+    split = data.split
+    counts, _ = data.comm.counts_displs_shape(data.shape, split)
+    phys = data.parray
+    block = int(phys.shape[split]) // data.comm.size
+    shards = sorted(phys.addressable_shards, key=lambda s: s.index[split].start or 0)
+    for s in shards:
+        r = (s.index[split].start or 0) // block if block else 0
+        c = counts[r]
+        if c:
+            idx = [slice(None)] * data.ndim
+            idx[split] = slice(0, c)
+            yield r, np.asarray(s.data[tuple(idx)])
+
+
 def _write_h5_dataset(handle, dataset: str, data: DNDarray, **kwargs):
     """Create ``dataset`` and stream ``data`` into it shard by shard."""
     jdt = np.dtype(data.dtype.jax_type())
@@ -219,19 +246,10 @@ def _write_h5_dataset(handle, dataset: str, data: DNDarray, **kwargs):
         dset[...] = data.numpy()
         return dset
     counts, displs = data.comm.counts_displs_shape(data.shape, split)
-    phys = data.parray
-    block = int(phys.shape[split]) // data.comm.size
-    for s in phys.addressable_shards:
-        start = s.index[split].start or 0
-        r = start // block if block else 0
-        c = counts[r]
-        if c == 0:
-            continue
-        idx = [slice(None)] * data.ndim
-        idx[split] = slice(0, c)
-        tgt = list(s.index)
-        tgt[split] = slice(displs[r], displs[r] + c)
-        dset[tuple(tgt)] = np.asarray(s.data[tuple(idx)])
+    for r, arr in _rank_ordered_blocks(data):
+        tgt = [slice(None)] * data.ndim
+        tgt[split] = slice(displs[r], displs[r] + counts[r])
+        dset[tuple(tgt)] = arr
     return dset
 
 
@@ -326,6 +344,74 @@ def _scan_line_offsets(path: str, header_lines: int) -> Tuple[np.ndarray, int]:
     # drop header lines and empty trailing line starts
     starts = offsets[header_lines:-1]
     return np.asarray(starts + [offsets[-1]], dtype=np.int64), size
+
+
+# ----------------------------------------------------------------------------
+# npy (beyond the reference: numpy's native format, streamed per shard)
+# ----------------------------------------------------------------------------
+def load_npy(
+    path: str,
+    dtype=None,
+    split: Optional[int] = None,
+    device=None,
+    comm=None,
+) -> DNDarray:
+    """Load a ``.npy`` file (beyond the reference — numpy's native format).
+
+    The file is memory-mapped (``np.load(mmap_mode="r")``), so with ``split``
+    given each device's block is a lazy per-range read: no host allocation
+    ever holds the full array. The dtype defaults to the file's own.
+    """
+    if not isinstance(path, str):
+        raise TypeError(f"path must be str, but was {type(path)}")
+    comm = sanitize_comm(comm)
+    device = devices_module.sanitize_device(device)
+    mm = np.load(path, mmap_mode="r")
+    if dtype is None:
+        dtype = types.canonical_heat_type(mm.dtype)
+    if split is None or mm.ndim == 0:
+        return factories.array(np.asarray(mm), dtype=dtype, split=None, device=device, comm=comm)
+    split = split % mm.ndim
+    return _sharded_ingest(lambda sl: mm[sl], tuple(mm.shape), dtype, split, device, comm)
+
+
+def save_npy(data: DNDarray, path: str) -> None:
+    """Save to ``.npy`` (beyond the reference), streaming shard blocks.
+
+    The npy format is a fixed header plus the C-order buffer, so a split-0
+    array appends one shard block at a time in rank order — the same
+    no-global-gather contract as :func:`save_csv`/:func:`save_hdf5`. A
+    split-1 operand reshards to rows first (one alltoall); replicated
+    operands write their local payload directly.
+    """
+    if not isinstance(data, DNDarray):
+        raise TypeError(f"data must be a DNDarray, but was {type(data)}")
+    if not isinstance(path, str):
+        raise TypeError(f"path must be str, but was {type(path)}")
+
+    npdtype = np.dtype(data.dtype.jax_type())
+    if data.split is None or data.comm.size == 1 or data.ndim == 0:
+        # file-object form: np.save(str_path) would append a '.npy' suffix,
+        # making the output filename depend on the operand's split state
+        with open(path, "wb") as fh:
+            np.save(fh, np.asarray(data.larray))
+        return
+    if data.split != 0:
+        from .manipulations import resplit as _resplit
+
+        data = _resplit(data, 0)
+
+    header = {
+        "descr": np.lib.format.dtype_to_descr(npdtype),
+        "fortran_order": False,
+        "shape": tuple(int(s) for s in data.shape),
+    }
+    with open(path, "wb") as fh:
+        # version 1.0: these headers always fit it, and it has the widest
+        # third-party reader support (numpy's own automatic choice)
+        np.lib.format.write_array_header_1_0(fh, header)
+        for _, arr in _rank_ordered_blocks(data):
+            np.ascontiguousarray(arr.astype(npdtype, copy=False)).tofile(fh)
 
 
 def load_csv(
@@ -455,16 +541,8 @@ def save_csv(
             arr = np.asarray(data.larray)  # local payload, not a gather
             yield arr if arr.ndim == 2 else arr[:, None]
             return
-        counts, _ = data.comm.counts_displs_shape(data.shape, 0)
-        phys = data.parray
-        block = int(phys.shape[0]) // data.comm.size
-        shards = sorted(phys.addressable_shards, key=lambda s: s.index[0].start or 0)
-        for s in shards:
-            r = (s.index[0].start or 0) // block if block else 0
-            c = counts[r]
-            if c:
-                arr = np.asarray(s.data[:c])
-                yield arr if arr.ndim == 2 else arr[:, None]
+        for _, arr in _rank_ordered_blocks(data):
+            yield arr if arr.ndim == 2 else arr[:, None]
 
     def write_header(f):
         for line in header_lines or ():

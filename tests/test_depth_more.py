@@ -190,6 +190,22 @@ class TestPrintingDepth(TestCase):
         self.assertIn("...", s)
         self.assertLess(len(s), 2000)
 
+    def test_repr_identical_across_splits(self):
+        data = np.arange(24.0).reshape(6, 4)
+        reprs = {s: repr(ht.array(data, split=s)) for s in (None, 0, 1)}
+        # the split tag differs; the VALUES shown must not
+        bodies = {s: r.split("split=")[0] for s, r in reprs.items()}
+        assert bodies[None] == bodies[0] == bodies[1]
+
+    def test_printoptions_roundtrip(self):
+        old = ht.get_printoptions()
+        try:
+            ht.set_printoptions(precision=2)
+            s = str(ht.array(np.array([1.23456789])))
+            assert "1.23" in s and "1.2345" not in s
+        finally:
+            ht.set_printoptions(**old)
+
     def test_print0(self):
         import contextlib
         import io

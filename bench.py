@@ -162,9 +162,27 @@ def worker() -> None:
         return _lloyd_run(data, centers, K, steps)
 
     # warmup/compile (fused ITERS-step program, one dispatch); synchronize via
-    # a scalar host read — block_until_ready is unreliable on the axon backend
-    _, _, _, shift = _primary_run(ITERS)
-    float(shift)
+    # a scalar host read — block_until_ready is unreliable on the axon backend.
+    # If the pallas kernel fails to LOWER on this backend (Mosaic support
+    # through the tunnel is unproven — the r03 capture predates the kernel),
+    # fall back to the jnp path rather than crashing before anything banks.
+    warm_err = None
+    for attempt in range(2):  # one retry: a tunnel hiccup at the host read
+        # must not permanently downgrade the round's primary to the jnp path
+        try:
+            _, _, _, shift = _primary_run(ITERS)
+            float(shift)
+            warm_err = None
+            break
+        except Exception as exc:  # noqa: BLE001 - a dead primary loses the record
+            warm_err = exc
+    if warm_err is not None:
+        if not use_fused:
+            raise warm_err
+        use_fused = False
+        lloyd_path = f"jnp (fused kernel failed twice: {repr(warm_err)[:120]})"
+        _, _, _, shift = _primary_run(ITERS)
+        float(shift)
     best = float("inf")
     for _ in range(3):
         start = time.perf_counter()

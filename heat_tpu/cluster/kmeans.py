@@ -163,21 +163,42 @@ class KMeans(_KCluster):
         n_global = int(x.shape[0])
         while done < self.max_iter:
             chunk = min(8, self.max_iter - done)
-            if mode == "single":
-                centers, labels, inertia, shift = _lloyd.fused_lloyd_run(
-                    data, centers, self.n_clusters, chunk, interpret=interpret
+            try:
+                if mode == "single":
+                    centers, labels, inertia, shift = _lloyd.fused_lloyd_run(
+                        data, centers, self.n_clusters, chunk, interpret=interpret
+                    )
+                elif mode == "sharded":
+                    centers, labels, inertia, shift = _lloyd.fused_lloyd_run_sharded(
+                        data, centers, self.n_clusters, x.comm, n_global, chunk,
+                        interpret=interpret,
+                    )
+                else:
+                    centers, labels, inertia, shift = _lloyd_run(
+                        data, centers, self.n_clusters, chunk
+                    )
+                # the host read is INSIDE the try: on async backends a kernel
+                # that lowered fine can still fail at execution, surfacing
+                # only at this scalar fetch
+                shift_val = float(shift)
+            except Exception as exc:
+                if mode is None:
+                    raise
+                # the pallas kernel failed to lower/run on this backend
+                # (Mosaic support varies): fall back to the jnp oracle path
+                # rather than failing the fit — loudly, never silently
+                import warnings
+
+                warnings.warn(
+                    "KMeans fused Lloyd kernel failed on this backend "
+                    f"({repr(exc)[:160]}); falling back to the jnp path",
+                    stacklevel=2,
                 )
-            elif mode == "sharded":
-                centers, labels, inertia, shift = _lloyd.fused_lloyd_run_sharded(
-                    data, centers, self.n_clusters, x.comm, n_global, chunk,
-                    interpret=interpret,
-                )
-            else:
-                centers, labels, inertia, shift = _lloyd_run(
-                    data, centers, self.n_clusters, chunk
-                )
+                mode = None
+                data = x.larray.astype(fdtype)
+                continue
             done += chunk
-            if float(shift) <= self.tol:
+            if shift_val <= self.tol:
                 break
 
         self._n_iter = done

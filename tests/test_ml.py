@@ -170,6 +170,27 @@ class TestCluster(TestCase):
         self.assertGreater(_cluster_accuracy(km.labels_.numpy(), y, 2), 0.99)
         self.assertEqual(km.labels_.shape[0], 203)
 
+    def test_kmeans_fused_backend_failure_falls_back(self):
+        # a pallas kernel that fails to lower on the backend (Mosaic support
+        # varies across TPU runtimes) must degrade to the jnp path with a
+        # warning, never fail the fit
+        import unittest.mock
+        import warnings as _w
+
+        from heat_tpu.ops import lloyd as _lloyd_mod
+
+        X, y = make_blobs()
+        with unittest.mock.patch.object(
+            _lloyd_mod, "fused_lloyd_run_sharded", side_effect=RuntimeError("mosaic")
+        ):
+            with _w.catch_warnings(record=True) as rec:
+                _w.simplefilter("always")
+                km = ht.cluster.KMeans(
+                    n_clusters=3, random_state=5, use_fused=True, max_iter=50
+                ).fit(ht.array(X, split=0))
+        self.assertTrue(any("falling back" in str(x.message) for x in rec))
+        self.assertGreater(_cluster_accuracy(km.labels_.numpy(), y, 3), 0.95)
+
     def test_kmeans_forced_fused_unhonorable_warns(self):
         # use_fused=True with no fused dispatch available must be loud, not
         # a vacuous pass through the jnp oracle

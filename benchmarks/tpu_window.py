@@ -1,0 +1,351 @@
+"""Opportunistic one-window TPU capture.
+
+The axon tunnel to the real chip is up for minutes at a time between long
+outages, so every TPU-gated measurement in this repo must be capturable in
+ONE window without supervision. This script runs a ladder of independent
+stages in a single process (device bring-up paid once) and re-writes its
+``--out`` JSON after EVERY stage, so a tunnel death mid-run still banks the
+completed stages. Re-running MERGES: stages that already succeeded in the
+out-file are skipped, failed/missing ones retry — an outer retry loop makes
+the artifact monotone across windows.
+
+Stages (each independently try/except'd):
+  init          platform + dispatch round-trip floor
+  mosaic_probe  a trivial 128-lane-aligned pallas kernel — distinguishes
+                "this backend cannot compile ANY Mosaic kernel" from "a
+                specific kernel is at fault" (the r04 bench saw the fused
+                Lloyd kernel 500 through the remote-compile helper)
+  mosaic_narrow same, but with a (block, 16) narrow-lane block — the fused
+                Lloyd kernel's one unusual layout choice
+  lloyd_small   fused_lloyd_run on 64k rows: full error text if it fails
+  lloyd_full    fused vs jnp Lloyd at the bench shape (10M x 16, k=8)
+  capability    MXU matmul bf16/f32 TFLOP/s + HBM triad GB/s (the roofline
+                refinement triad bench.py reads from TPU_CAPABILITY.json)
+  cholqr2       CholeskyQR2 vs TSQR at the qr bench shape (VERDICT ask 6)
+  moments_diag  eager ht.mean+ht.std vs the same fused in one jit program —
+                attributes the eager number's RTT share
+  attention     pallas flash attention vs dense at 4k causal
+
+Usage: python benchmarks/tpu_window.py [--out benchmarks/TPU_WINDOW_r04.json]
+       [--stages init,mosaic_probe,...] [--skip-full]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import time
+import traceback
+
+
+def _bank(out_path: str, doc: dict) -> None:
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=1)
+        fh.write("\n")
+    os.replace(tmp, out_path)
+
+
+def _timeit(fn, sync, reps=3):
+    sync(fn())  # warmup/compile
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        sync(fn())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _err(exc: BaseException) -> str:
+    tb = traceback.format_exc()
+    return (repr(exc)[:600] + " || tb-tail: " + tb[-1200:]) if tb else repr(exc)[:600]
+
+
+# ---------------------------------------------------------------------------
+# stages — each returns a dict merged under its own key
+# ---------------------------------------------------------------------------
+def stage_init():
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    tiny = jax.jit(lambda a: a.sum())
+    tv = jnp.ones(8)
+    rtt = _timeit(lambda: tiny(tv), lambda r: float(r), reps=5)
+    return {
+        "device": str(dev),
+        "platform": dev.platform,
+        "n_devices": len(jax.devices()),
+        "dispatch_rtt_ms": round(rtt * 1e3, 2),
+    }
+
+
+def _probe_kernel(f_lane: int):
+    """Compile+run a minimal pallas kernel whose block last-dim is f_lane."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(x_ref, o_ref):
+        o_ref[:, :] = x_ref[:, :] * 2.0 + 1.0
+
+    n = 512
+    x = jnp.ones((n, f_lane), jnp.float32)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n, f_lane), jnp.float32),
+        grid=(n // 256,),
+        in_specs=[pl.BlockSpec((256, f_lane), lambda i: (i, 0), memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((256, f_lane), lambda i: (i, 0), memory_space=pltpu.VMEM),
+    )(x)
+    return float(out[0, 0])
+
+
+def stage_mosaic_probe():
+    return {"ok": _probe_kernel(128) == 3.0}
+
+
+def stage_mosaic_narrow():
+    return {"ok": _probe_kernel(16) == 3.0}
+
+
+def stage_lloyd_small():
+    import jax
+    import jax.numpy as jnp
+
+    from heat_tpu.ops.lloyd import fused_lloyd_run
+
+    n, f, k = 65536, 16, 8
+    data = jax.random.normal(jax.random.PRNGKey(0), (n, f), dtype=jnp.float32)
+    centers = jax.random.normal(jax.random.PRNGKey(1), (k, f), dtype=jnp.float32) * 3
+    _, _, inertia, shift = fused_lloyd_run(data, centers, k, 2)
+    return {"ok": True, "inertia": float(inertia), "shift": float(shift)}
+
+
+def stage_lloyd_full():
+    import jax
+    import jax.numpy as jnp
+
+    from heat_tpu.cluster.kmeans import _lloyd_run
+    from heat_tpu.ops.lloyd import fused_lloyd_run
+
+    n, f, k, iters = 10_000_000, 16, 8, 10
+    data = jax.random.normal(jax.random.PRNGKey(1), (n, f), dtype=jnp.float32)
+    centers = jax.random.normal(jax.random.PRNGKey(2), (k, f), dtype=jnp.float32) * 3
+    out = {"n": n}
+    for name, fn in (("fused", fused_lloyd_run), ("jnp", _lloyd_run)):
+        try:
+            best = _timeit(lambda: fn(data, centers, k, iters), lambda r: float(r[3]), reps=3)
+            out[f"{name}_iters_per_sec"] = round(iters / best, 2)
+            # two-point marginal: 3x iterations cancels fixed dispatch cost
+            best3 = _timeit(lambda: fn(data, centers, k, 3 * iters), lambda r: float(r[3]), reps=2)
+            if best3 >= 1.5 * best:
+                out[f"{name}_iters_per_sec_marginal"] = round(2 * iters / (best3 - best), 2)
+        except Exception as exc:  # noqa: BLE001 - bank the other path regardless
+            out[f"{name}_error"] = _err(exc)
+    if out.get("fused_iters_per_sec") and out.get("jnp_iters_per_sec"):
+        out["fused_vs_jnp"] = round(
+            (out.get("fused_iters_per_sec_marginal") or out["fused_iters_per_sec"])
+            / (out.get("jnp_iters_per_sec_marginal") or out["jnp_iters_per_sec"]),
+            2,
+        )
+    return out
+
+
+def stage_capability():
+    import jax
+    import jax.numpy as jnp
+
+    out = {}
+    tiny = jax.jit(lambda a: a.sum())
+    tv = jnp.ones(8)
+    rtt = _timeit(lambda: tiny(tv), lambda r: float(r), reps=5)
+
+    def corrected(best):
+        return max(best - rtt, 1e-9)
+
+    for dtype, name in ((jnp.bfloat16, "bf16"), (jnp.float32, "f32")):
+        n = 4096
+        a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.float32).astype(dtype)
+        b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.float32).astype(dtype)
+        mm = jax.jit(lambda x, y: (x @ y).astype(jnp.float32))
+        best = _timeit(lambda: mm(a, b), lambda r: float(r[0, 0]))
+        flops = 2.0 * n * n * n
+        out[f"matmul_{name}_{n}_tflops"] = round(flops / best / 1e12, 2)
+        out[f"matmul_{name}_{n}_tflops_rtt_corrected"] = round(flops / corrected(best) / 1e12, 2)
+
+    n = 64 * 1024 * 1024
+    x = jax.random.normal(jax.random.PRNGKey(2), (n,), jnp.float32)
+    y = jax.random.normal(jax.random.PRNGKey(3), (n,), jnp.float32)
+    triad = jax.jit(lambda a, b: (a * 1.5 + b).sum())
+    best = _timeit(lambda: triad(x, y), lambda r: float(r))
+    out["hbm_read_gbps"] = round(2 * n * 4 / best / 1e9, 1)
+    out["hbm_read_gbps_rtt_corrected"] = round(2 * n * 4 / corrected(best) / 1e9, 1)
+    out["dispatch_rtt_ms"] = round(rtt * 1e3, 2)
+    return out
+
+
+def stage_cholqr2():
+    import jax
+    import jax.numpy as jnp
+
+    import heat_tpu as ht
+
+    comm = ht.get_comm()
+    m, n = (1 << 21), 256
+    a = ht.array(
+        jax.device_put(
+            jax.random.normal(jax.random.PRNGKey(4), (m, n), dtype=jnp.float32),
+            comm.sharding(2, 0),
+        ),
+        is_split=0,
+    )
+    out = {"shape": [m, n]}
+    flops = 2.0 * m * n * n
+    for method in ("tsqr", "cholqr2"):
+        try:
+            best = _timeit(
+                lambda: ht.linalg.qr(a, method=method),
+                lambda qr_: float(qr_[1].larray[0, 0]),
+                reps=2,
+            )
+            out[f"qr_{method}_tflops"] = round(flops / best / 1e12, 3)
+        except Exception as exc:  # noqa: BLE001
+            out[f"qr_{method}_error"] = _err(exc)
+    if out.get("qr_cholqr2_tflops") and out.get("qr_tsqr_tflops"):
+        out["cholqr2_vs_tsqr"] = round(out["qr_cholqr2_tflops"] / out["qr_tsqr_tflops"], 2)
+    return out
+
+
+def stage_moments_diag():
+    import jax
+    import jax.numpy as jnp
+
+    import heat_tpu as ht
+
+    comm = ht.get_comm()
+    n = 1_000_000
+    mom = ht.array(
+        jax.device_put(
+            jax.random.normal(jax.random.PRNGKey(3), (n,), dtype=jnp.float32),
+            comm.sharding(1, 0),
+        ),
+        is_split=0,
+    )
+    # eager API path (what bench.py's moments_ms_1M measures): 2 dispatches
+    def eager():
+        float(ht.mean(mom).larray)
+        float(ht.std(mom).larray)
+        return 0.0
+
+    best_eager = _timeit(lambda: eager(), lambda r: r, reps=5)
+
+    # same arithmetic, ONE program, one host read — the dispatch floor
+    fused = jax.jit(lambda x: (x.mean(), x.std()))
+
+    def one_shot():
+        m_, s_ = fused(mom.larray)
+        return float(m_) + float(s_)
+
+    best_fused = _timeit(lambda: one_shot(), lambda r: r, reps=5)
+    return {
+        "eager_api_ms": round(best_eager * 1e3, 3),
+        "fused_one_dispatch_ms": round(best_fused * 1e3, 3),
+        "eager_rtt_share_pct": round(100.0 * (1 - best_fused / best_eager), 1),
+    }
+
+
+def stage_attention():
+    import jax
+    import jax.numpy as jnp
+
+    from heat_tpu.nn.attention import dot_product_attention
+    from heat_tpu.ops.flash import flash_attention_tpu as flash_attention
+
+    B, S, H, D = 1, 4096, 8, 128
+    q, k, v = (
+        jax.random.normal(kk, (B, S, H, D), jnp.float32)
+        for kk in jax.random.split(jax.random.PRNGKey(4), 3)
+    )
+    att_flops = 4.0 * B * H * S * S * D / 2
+    out = {}
+    fl = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    best = _timeit(lambda: fl(q, k, v), lambda r: float(r[0, 0, 0, 0]))
+    out["flash_attn_causal_4k_tflops"] = round(att_flops / best / 1e12, 2)
+    dn = jax.jit(lambda q, k, v: dot_product_attention(q, k, v, causal=True))
+    best_d = _timeit(lambda: dn(q, k, v), lambda r: float(r[0, 0, 0, 0]))
+    out["dense_attn_causal_4k_tflops"] = round(att_flops / best_d / 1e12, 2)
+    out["flash_vs_dense_speedup"] = round(best_d / best, 2)
+    return out
+
+
+STAGES = {
+    "init": stage_init,
+    "mosaic_probe": stage_mosaic_probe,
+    "mosaic_narrow": stage_mosaic_narrow,
+    "lloyd_small": stage_lloyd_small,
+    "lloyd_full": stage_lloyd_full,
+    "capability": stage_capability,
+    "cholqr2": stage_cholqr2,
+    "moments_diag": stage_moments_diag,
+    "attention": stage_attention,
+}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default="benchmarks/TPU_WINDOW_r04.json")
+    parser.add_argument("--stages", default=",".join(STAGES))
+    parser.add_argument(
+        "--skip-full", action="store_true", help="skip the 10M-row lloyd_full stage"
+    )
+    args = parser.parse_args()
+
+    doc = {}
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as fh:
+                doc = json.load(fh)
+        except Exception:  # noqa: BLE001 - a corrupt artifact never blocks capture
+            doc = {}
+
+    wanted = [s for s in args.stages.split(",") if s in STAGES]
+    if args.skip_full and "lloyd_full" in wanted:
+        wanted.remove("lloyd_full")
+
+    for name in wanted:
+        prior = doc.get(name)
+        # a stage re-runs if ANY of its keys records an error (lloyd_full /
+        # cholqr2 bank per-path errors like fused_error / qr_tsqr_error)
+        if isinstance(prior, dict) and not any("error" in k for k in prior):
+            print(f"[skip] {name}: already banked", flush=True)
+            continue
+        t0 = time.perf_counter()
+        try:
+            res = STAGES[name]()
+            res["seconds"] = round(time.perf_counter() - t0, 1)
+            doc[name] = res
+            print(f"[ok]   {name}: {json.dumps(res)[:200]}", flush=True)
+        except Exception as exc:  # noqa: BLE001 - every stage is independent
+            doc[name] = {"error": _err(exc), "seconds": round(time.perf_counter() - t0, 1)}
+            print(f"[fail] {name}: {repr(exc)[:200]}", flush=True)
+        doc["captured_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        _bank(args.out, doc)
+
+    # refresh the roofline-refinement artifact bench.py reads, when the triad
+    # stage has real numbers
+    cap = doc.get("capability")
+    if isinstance(cap, dict) and cap.get("hbm_read_gbps") and doc.get("init", {}).get(
+        "platform"
+    ) not in (None, "cpu"):
+        cap_doc = dict(cap)
+        cap_doc["device"] = doc.get("init", {}).get("device")
+        cap_doc["captured_utc"] = doc["captured_utc"]
+        _bank(os.path.join(os.path.dirname(os.path.abspath(args.out)), "TPU_CAPABILITY.json"), cap_doc)
+
+
+if __name__ == "__main__":
+    main()

@@ -818,6 +818,42 @@ class DNDarray:
     __hash__ = None  # type: ignore[assignment]
 
     # ------------------------------------------------------------------
+    # pytree protocol — beyond the reference (which is eager-only)
+    # ------------------------------------------------------------------
+    def _tree_flatten(self):
+        """Flatten to (physical payload, static metadata).
+
+        Registering DNDarray as a pytree makes whole ``ht.*`` pipelines
+        compilable with plain ``jax.jit`` (and differentiable with
+        ``jax.grad``): the payload becomes the traced leaf while
+        gshape/dtype/split stay static aux data. Eager per-op dispatch —
+        the reference's only execution model, and ~all of the wall time of
+        small ops on a remote TPU (one tunnel round-trip per op) — then
+        collapses into one XLA program per pipeline.
+        """
+        aux = (self.__gshape, self.__dtype, self.__split, self.__device, self.__comm)
+        return (self.__array,), aux
+
+    @classmethod
+    def _tree_unflatten(cls, aux, children):
+        """Rebuild from :meth:`_tree_flatten` parts WITHOUT re-deriving
+        anything: the payload may be a tracer (under jit) or a sentinel
+        (tree_structure probes), so it must not be inspected; it is stored
+        at whatever (possibly padded physical) shape it carries."""
+        (payload,) = children
+        obj = cls.__new__(cls)
+        (
+            obj._DNDarray__gshape,
+            obj._DNDarray__dtype,
+            obj._DNDarray__split,
+            obj._DNDarray__device,
+            obj._DNDarray__comm,
+        ) = aux
+        obj._DNDarray__balanced = True
+        obj._DNDarray__array = payload
+        return obj
+
+    # ------------------------------------------------------------------
     # printing (reference heat/core/printing.py)
     # ------------------------------------------------------------------
     def __repr__(self) -> str:
@@ -957,3 +993,8 @@ def _ensure_split(array: jax.Array, split: Optional[int], comm: MeshCommunicatio
         except Exception:
             pass
     return jax.device_put(array, target)
+
+
+jax.tree_util.register_pytree_node(
+    DNDarray, DNDarray._tree_flatten, DNDarray._tree_unflatten
+)

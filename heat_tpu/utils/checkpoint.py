@@ -48,7 +48,15 @@ def _to_host(tree: Any) -> Any:
     read with ``np.asarray``; those are allgathered across processes first.
     """
 
+    from ..core.dndarray import DNDarray
+
     def to_np(x):
+        if isinstance(x, DNDarray):
+            # a DNDarray serializes as its LOGICAL global array (not the
+            # padded physical payload its pytree leaf carries); falling
+            # through to the jax.Array handling keeps the multi-host
+            # allgather path below
+            x = x.larray
         if not (hasattr(x, "dtype") or hasattr(x, "__array__")):
             return x
         if isinstance(x, jax.Array) and not x.is_fully_addressable:
@@ -57,7 +65,7 @@ def _to_host(tree: Any) -> Any:
             x = multihost_utils.process_allgather(x, tiled=True)
         return np.asarray(x)
 
-    return jax.tree.map(to_np, tree)
+    return jax.tree.map(to_np, tree, is_leaf=lambda x: isinstance(x, DNDarray))
 
 
 def save_checkpoint(directory: str, tree: Any, step: int = 0, keep: int = 3) -> str:

@@ -282,6 +282,59 @@ def stage_attention():
     return out
 
 
+def stage_train():
+    """DP ResNet18 samples/s on the live chip (BASELINE config 5's TPU leg;
+    the DASO cadence sweep needs a multi-device mesh and stays on the CPU
+    matrix — benchmarks/TRAIN_THROUGHPUT_r04.json)."""
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+
+    import heat_tpu as ht
+    from heat_tpu.core.dndarray import _ensure_split
+    from heat_tpu.nn import DataParallel, ResNet18
+
+    comm = ht.get_comm()
+    n_dev = comm.size
+    batch = 256 // n_dev * n_dev or n_dev
+    rng = np.random.default_rng(0)
+    x_np = rng.standard_normal((batch, 32, 32, 3)).astype(np.float32)
+    y_np = rng.integers(0, 10, size=batch).astype(np.int32)
+
+    dp = DataParallel(ResNet18(num_classes=10), comm=comm, optimizer=optax.sgd(0.05))
+    dp.init(0, x_np[: max(n_dev, 2)])
+    dp.train_step(x_np, y_np)  # compile
+
+    def one():
+        dp.train_step(x_np, y_np)
+        return 0.0
+
+    best = _timeit(lambda: one(), lambda r: r, reps=4)
+    out = {
+        "model": "resnet18",
+        "global_batch": batch,
+        "devices": n_dev,
+        "dp_samples_per_sec": round(batch / best, 1),
+        "dp_step_ms": round(best * 1e3, 2),
+    }
+    # breakdown: placement vs compiled compute (diagnosability through the
+    # tunnel — a RTT-dominated step must be visible in the artifact)
+    xb = _ensure_split(jnp.asarray(x_np), 0, comm)
+    yb = _ensure_split(jnp.asarray(y_np), 0, comm)
+
+    def compute_only():
+        if dp._stateful:
+            _, _, _, loss = dp._train_step(dp.params, dp.state, dp.opt_state, xb, yb)
+        else:
+            _, _, loss = dp._train_step(dp.params, dp.opt_state, xb, yb)
+        return float(loss)
+
+    t_compute = _timeit(lambda: compute_only(), lambda r: r, reps=4)
+    out["dp_compiled_step_ms"] = round(t_compute * 1e3, 2)
+    out["dp_samples_per_sec_compiled"] = round(batch / t_compute, 1)
+    return out
+
+
 STAGES = {
     "init": stage_init,
     "mosaic_probe": stage_mosaic_probe,
@@ -292,6 +345,7 @@ STAGES = {
     "cholqr2": stage_cholqr2,
     "moments_diag": stage_moments_diag,
     "attention": stage_attention,
+    "train": stage_train,
 }
 
 

@@ -1,0 +1,62 @@
+"""Compiled ht pipelines: jax.jit / jax.grad over DNDarrays.
+
+DNDarray is a registered JAX pytree (heat_tpu/core/dndarray.py:_tree_flatten),
+so whole ``ht.*`` call chains compile into ONE XLA program — one dispatch per
+pipeline call instead of one per op. The reference (torch + mpi4py,
+reference heat/core/dndarray.py) is eager-only: every op pays kernel-launch
+and, on a remote accelerator, a host round-trip.
+
+The demo fits a tiny ridge regression by gradient descent where the WHOLE
+update step — prediction, loss, gradient, parameter update — is a single
+compiled program over distributed arrays.
+"""
+
+import time
+
+import jax
+import numpy as np
+
+import heat_tpu as ht
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    n, f = 4096, 16
+    w_true = rng.standard_normal(f).astype(np.float32)
+    xn = rng.standard_normal((n, f)).astype(np.float32)
+    yn = xn @ w_true + 0.01 * rng.standard_normal(n).astype(np.float32)
+
+    x = ht.array(xn, split=0)  # rows sharded over the mesh
+    y = ht.array(yn, split=0)
+    w = ht.zeros(f, dtype=ht.float32)  # replicated parameters
+
+    def loss_fn(w):
+        resid = ht.linalg.matmul(x, w) - y
+        return (ht.mean(resid * resid) + 1e-4 * ht.sum(w * w)).larray
+
+    @jax.jit  # ONE program: forward + backward + update, collectives included
+    def step(w):
+        loss, g = jax.value_and_grad(loss_fn)(w)
+        return w - 0.5 * g, loss
+
+    t0 = time.perf_counter()
+    for i in range(60):
+        w, loss = step(w)  # loss is evaluated at the PRE-update iterate
+    elapsed = time.perf_counter() - t0
+
+    err = float(np.abs(w.numpy() - w_true).max())
+    print(f"compiled steps: 60 in {elapsed * 1e3:.1f} ms, loss at step 59: {float(loss):.5f}")
+    print(f"max |w - w_true| error {err:.4f}")
+    assert err < 0.05, "gradient descent did not converge"
+
+    # the same pipeline runs eagerly (per-op dispatch) — identical numbers:
+    # both evaluate the loss at the final iterate w_60
+    resid = ht.linalg.matmul(x, w) - y
+    eager_final = float((ht.mean(resid * resid) + 1e-4 * ht.sum(w * w)).larray)
+    compiled_final = float(jax.jit(loss_fn)(w))
+    print(f"eager vs compiled loss at w_60: {eager_final:.5f} / {compiled_final:.5f}")
+    assert abs(eager_final - compiled_final) < 1e-5
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,7 @@ SMOKE_SCRIPTS = [
     "nn_mnist_style",
     "daso_training",
     "long_context_lm",
+    "compiled_pipeline",
 ]
 
 

@@ -276,7 +276,6 @@ def _det_program(mesh, axis, p, n, rows_loc, n_stages, owners, dtype_name):
 
     dtype = jnp.dtype(dtype_name)
     n_pad = p * rows_loc
-    owners_arr = jnp.asarray(owners, jnp.int32)
 
     # sign handling: each device counts its own stages' negative pivot
     # signs; one scalar psum at the end turns the count's parity into the
@@ -284,6 +283,10 @@ def _det_program(mesh, axis, p, n, rows_loc, n_stages, owners, dtype_name):
     from ._blocked import sanitize_slab
 
     def device_fn(Al):
+        # constants must be created INSIDE the traced fn: this factory can
+        # first run during an outer jit trace (det under a user's jax.jit),
+        # and a build-time jnp constant would cache that trace's tracer
+        owners_arr = jnp.asarray(owners, jnp.int32)
         idx = jax.lax.axis_index(axis)
         W, _ = sanitize_slab(Al, idx, rows_loc, n, n_pad, dtype)  # pad rows: det 1
 
@@ -348,11 +351,14 @@ def _cholesky_program(mesh, axis, p, n, rows_loc, n_stages, owners, dtype_name):
 
     dtype = jnp.dtype(dtype_name)
     n_pad = p * rows_loc
-    owners_arr = jnp.asarray(owners, jnp.int32)
 
     def device_fn(Al):
         from ._blocked import sanitize_slab
 
+        # created inside the trace — a build-time jnp constant would leak a
+        # tracer into the lru_cache when the factory first runs under an
+        # outer jit (see _det_program)
+        owners_arr = jnp.asarray(owners, jnp.int32)
         idx = jax.lax.axis_index(axis)
         W, _ = sanitize_slab(Al, idx, rows_loc, n, n_pad, dtype)
         L = jnp.zeros_like(W)
@@ -442,7 +448,10 @@ def cholesky(a: DNDarray) -> DNDarray:
         L_pad = fn(af.parray)
         # pad rows factor as identity; slice the logical block
         L = L_pad[:n, :n]
-        if not bool(jnp.isfinite(jnp.diagonal(L)).all()):
+        # the LinAlgError probe is a host read — impossible under a jit
+        # trace, where a non-SPD operand propagates nan instead (numpy's
+        # exception contract holds eagerly)
+        if sanitation.is_concrete(L) and not bool(jnp.isfinite(jnp.diagonal(L)).all()):
             raise np.linalg.LinAlgError("cholesky: matrix is not positive definite")
         out = _wrap_like(L, a.split, a)
         return out
@@ -452,7 +461,7 @@ def cholesky(a: DNDarray) -> DNDarray:
 
     sym = mirror_triangle(a.larray.astype(_float_for(a)), "L")
     result = jnp.linalg.cholesky(sym)
-    if not bool(jnp.isfinite(result).all()):
+    if sanitation.is_concrete(result) and not bool(jnp.isfinite(result).all()):
         raise np.linalg.LinAlgError("cholesky: matrix is not positive definite")
     return _wrap_like(result, a.split, a)
 
@@ -493,6 +502,13 @@ def _slogdet_core(a: DNDarray, op: str):
         jnp.dtype(_float_for(af)).name,
     )
     sign, logabs = fn(af.parray)
+    if not sanitation.is_concrete(logabs):
+        # under a jit trace the singular-tile probe (a host read) cannot
+        # run: return the program's result directly. An exactly-singular
+        # final block is still the valid (0, -inf); a singular NON-final
+        # diagonal tile — which the eager path would catch and retry on the
+        # replicated kernel — propagates nan, as numpy's LU would overflow
+        return sign, logabs
     singular_exact = bool((sign == 0) & (logabs == -jnp.inf))
     if bool(jnp.isfinite(logabs)) or singular_exact:
         return sign, logabs
@@ -739,12 +755,17 @@ def trace(
         result = result.astype(types.canonical_heat_type(dtype).jax_type())
     ret = _wrap_like(result, None, a)
     if a.ndim == 2:
-        # scalar result mirrors the reference's behavior of returning a scalar
-        scalar = ret.item() if ret.ndim == 0 else ret
-        if out is not None and isinstance(scalar, DNDarray):
-            out._replace(scalar.larray, scalar.split)
-            return out
-        return scalar
+        # the reference rejects out= for the scalar 2-D case (reference
+        # basics.py:1756-1762); the scalar return mirrors its behavior —
+        # except under a jit trace, where the host read is impossible and
+        # the 0-d DNDarray is returned instead
+        if out is not None:
+            raise ValueError(
+                "`out` is not applicable if result is a scalar / input `a` is 2-dimensional"
+            )
+        if ret.ndim == 0 and sanitation.is_concrete(result):
+            return ret.item()
+        return ret
     if out is not None:
         out._replace(ret.larray, ret.split)
         return out

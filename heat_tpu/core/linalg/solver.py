@@ -99,11 +99,14 @@ def _tri_solve_program(mesh, axis, p, n, k, rows_loc, n_stages, owners, lower, d
 
     dtype = jnp.dtype(dtype_name)
     n_pad = p * rows_loc
-    owners_arr = jnp.asarray(owners, jnp.int32)
 
     from ._blocked import sanitize_slab
 
     def device_fn(Al, bl):
+        # created inside the trace — a build-time jnp constant would leak a
+        # tracer into the lru_cache when the factory first runs under an
+        # outer jit (see basics._det_program)
+        owners_arr = jnp.asarray(owners, jnp.int32)
         idx = jax.lax.axis_index(axis)
         # pad columns so every diagonal tile is square; pad rows become
         # identity rows, so their solution is exactly b's zero padding

@@ -17,6 +17,7 @@ from .dndarray import DNDarray
 from .stride_tricks import sanitize_axis, sanitize_shape
 
 __all__ = [
+    "is_concrete",
     "sanitize_in",
     "sanitize_infinity",
     "sanitize_in_tensor",
@@ -27,6 +28,17 @@ __all__ = [
     "scalar_to_1d",
     "warn_replicated",
 ]
+
+
+def is_concrete(x) -> bool:
+    """Whether ``x`` is a concrete array (host reads allowed) rather than a
+    jit-trace tracer. The shared probe behind every "defer the host read
+    under a trace" guard (det's singular-tile retry, cholesky's LinAlgError
+    probe, trace's scalar return), kept in one place so a jax relocation of
+    the Tracer type is a one-line fix."""
+    import jax
+
+    return not isinstance(x, jax.core.Tracer)
 
 
 class ReplicationWarning(UserWarning):

@@ -280,16 +280,37 @@ def digitize(x: DNDarray, bins, right: bool = False) -> DNDarray:
 
 
 def histc(input: DNDarray, bins: int = 100, min: float = 0.0, max: float = 0.0, out=None) -> DNDarray:
-    """Histogram with equal-width bins (reference statistics.py:591-651)."""
+    """Histogram with equal-width bins (reference statistics.py:591-651).
+
+    The data-derived default range stays on device (traced scalars), so the
+    op composes under ``jax.jit`` pipelines."""
     sanitation.sanitize_in(input)
-    lo, hi = float(min), float(max)
     data = input.larray
-    if lo == 0.0 and hi == 0.0:
-        lo = float(jnp.min(data))
-        hi = float(jnp.max(data))
-    if lo == hi:
-        lo -= 1.0
-        hi += 1.0
+    if sanitation.is_concrete(data):
+        # eager: Python float64 range arithmetic (the degenerate ±1
+        # expansion must not round away at large magnitudes — f32 ulp at
+        # 1e8 is 8)
+        lo, hi = float(min), float(max)
+        if lo == 0.0 and hi == 0.0:
+            lo = float(jnp.min(data))
+            hi = float(jnp.max(data))
+        if lo == hi:
+            lo -= 1.0
+            hi += 1.0
+    else:
+        # under a jit trace the data-derived range stays on device, in the
+        # widest float the backend offers (f64 under x64, else f32 — the
+        # degenerate expansion can round away at magnitudes ≥ 2^24 there)
+        wdt = jnp.promote_types(data.dtype, jnp.float32)
+        if float(min) == 0.0 and float(max) == 0.0:
+            lo = jnp.min(data).astype(wdt)
+            hi = jnp.max(data).astype(wdt)
+        else:
+            lo = jnp.asarray(float(min), wdt)
+            hi = jnp.asarray(float(max), wdt)
+        degenerate = lo == hi
+        lo = jnp.where(degenerate, lo - 1.0, lo)
+        hi = jnp.where(degenerate, hi + 1.0, hi)
     # torch.histc excludes out-of-range elements; bin index is direct
     # arithmetic on the equal-width grid, counted scatter-free
     data = data.reshape(-1)

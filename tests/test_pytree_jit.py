@@ -137,6 +137,63 @@ class TestGradThroughHtOps:
         np.testing.assert_allclose(grad.numpy(), gn, rtol=2e-4, atol=1e-5)
 
 
+class TestOpTraceability:
+    """The op library composes under jit: ops whose host reads were
+    incidental (histc's data-derived range, trace's scalar read, det's
+    singular-tile probe, cholesky's LinAlgError probe) now defer them under
+    a trace; inherently data-dependent ops (unique/nonzero: output shapes;
+    allclose: Python bool) raise jax's standard concretization errors."""
+
+    def test_histc_traces_and_matches_eager(self):
+        v = ht.arange(16, dtype=ht.float32, split=0)
+        j = jax.jit(lambda a: ht.histc(a, bins=4))(v)
+        e = ht.histc(v, bins=4)
+        np.testing.assert_array_equal(j.numpy(), e.numpy())
+
+    def test_trace_traces_returns_0d(self):
+        sq = ht.array(np.eye(4, dtype=np.float32) * 3 + 1, split=0)
+        j = jax.jit(lambda a: ht.trace(a))(sq)
+        assert isinstance(j, DNDarray) and j.shape == ()
+        assert float(j.larray) == ht.trace(sq)  # eager keeps the scalar contract
+
+    def test_det_then_slogdet_under_jit_no_tracer_leak(self):
+        # the cached program factories must not bake trace-time constants:
+        # det's first run under an outer jit used to poison the lru_cache
+        # for every later slogdet/solve trace
+        sq = ht.array(np.eye(4, dtype=np.float32) * 3 + 1, split=0)
+        d = jax.jit(lambda a: ht.linalg.det(a))(sq)
+        s = jax.jit(lambda a: ht.linalg.slogdet(a)[1])(sq)
+        np.testing.assert_allclose(float(d.larray), 189.0, rtol=1e-5)
+        np.testing.assert_allclose(float(s.larray), np.log(189.0), rtol=1e-5)
+
+    def test_solve_triangular_and_cholesky_under_jit(self):
+        rng = np.random.default_rng(0)
+        Ln = np.tril(rng.standard_normal((8, 8)).astype(np.float32)) + 4 * np.eye(
+            8, dtype=np.float32
+        )
+        bn = rng.standard_normal((8, 2)).astype(np.float32)
+        L = ht.array(Ln, split=0)
+        b = ht.array(bn, split=0)
+        xj = jax.jit(lambda A, r: ht.linalg.solve_triangular(A, r, lower=True))(L, b)
+        np.testing.assert_allclose(xj.numpy(), np.linalg.solve(Ln, bn), rtol=2e-5, atol=1e-6)
+        cj = jax.jit(lambda A: ht.linalg.cholesky(ht.linalg.matmul(A, A.T)))(L)
+        np.testing.assert_allclose(cj.numpy(), np.linalg.cholesky(Ln @ Ln.T), rtol=2e-4, atol=1e-4)
+        # the eager LinAlgError contract survives the trace-aware guard
+        with pytest.raises(np.linalg.LinAlgError):
+            ht.linalg.cholesky(ht.array(-np.eye(4, dtype=np.float32), split=0))
+
+    def test_untraceable_ops_raise_standard_errors(self):
+        v = ht.arange(16, dtype=ht.float32, split=0)
+        for fn in (
+            lambda a: ht.unique(a),
+            lambda a: ht.nonzero(a),
+            lambda a: ht.allclose(a, a),
+        ):
+            with pytest.raises(Exception) as ei:
+                jax.jit(fn)(v)
+            assert "Tracer" in repr(ei.value) or "Concretization" in repr(ei.value)
+
+
 class TestCheckpointInterplay:
     def test_checkpoint_tree_with_dndarray(self, tmp_path):
         from heat_tpu.utils.checkpoint import load_checkpoint, save_checkpoint

@@ -41,8 +41,16 @@ import argparse
 import functools
 import json
 import os
+import sys
 import time
 import traceback
+
+# Running as ``python benchmarks/tpu_window.py`` puts benchmarks/ (not the
+# repo root) on sys.path; heat_tpu lives at the root. Do NOT touch
+# PYTHONPATH for this — the axon backend registration rides on it.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
 
 def _bank(out_path: str, doc: dict) -> None:

@@ -17,9 +17,19 @@ the iteration needs in that single pass:
     sums   += onehotᵀ @ xb               (k, f)      MXU accumulator
     counts += Σ onehot                   (k,)        accumulator
 
-HBM traffic per iteration: n·f reads + n label writes — ~2x less than the
-fused-by-XLA jnp path (which cannot merge two contractions over the same
-operand into one read). The centroid update (k x f, tiny) runs outside.
+HBM traffic per iteration: n·f reads, and NOTHING per-row written — the
+kernel emits only the (k, f)/(1, k)/(1, 1) accumulators. Labels are not an
+iteration output at all: a ``(block, 1)`` label block lane-pads 1 → 128 in
+VMEM (it cost 8 MB of the 16 MB scoped budget — the r04 OOM) and a
+``(n, 1)`` array tiles to ~128x its size in HBM, so per-iteration label
+writes are exactly the waste a TPU-first design must avoid. The final
+assignment is a separate fused jnp epilogue (`_assign_labels`) executed
+once per program against the centers of the last iteration — the same
+labels the jnp oracle reports, at the cost of one extra data read per
+*program* (≤8 iterations), not per iteration. This is ~2x less traffic
+than the fused-by-XLA jnp path (which cannot merge two contractions over
+the same operand into one read). The centroid update (k x f, tiny) runs
+outside.
 
 The feature axis is NOT padded to the 128-lane width in HBM — blocks are
 DMA'd as (block, f) and padded only in VMEM — so the bandwidth advantage
@@ -57,10 +67,17 @@ __all__ = [
 ]
 
 def _block_rows(f: int) -> int:
-    """Rows per grid step, sized so one (BLOCK, f) f32 input block stays
-    ≤ 4 MB (≈8 MB with pallas's input double-buffering — comfortably inside
-    the ~16 MB VMEM budget with the accumulators)."""
-    return max(512, min(8192, ((1 << 22) // (4 * f)) // 8 * 8))
+    """Rows per grid step, sized against the REAL scoped-VMEM footprint on a
+    v5e (16 MB limit). Everything row-shaped is lane-padded to a multiple of
+    128: the double-buffered (block, f) input AND the kernel's live vector
+    intermediates — xb, score, onehot and the masked-min chain all occupy
+    block x 128 lanes of stack regardless of f or k. Budget ≈ 4 · block ·
+    (2 · lane_pad(f) + 4 · 128) bytes ≤ 12 MB (headroom for the (k, f)
+    accumulators and csq/cT). Measured: block=8192 at f=16 hit the 16 MB
+    scoped limit to within 1.5 KB even with NO per-row output."""
+    lanes = 128 * ((f + 127) // 128)
+    blk = (12 << 20) // (4 * (2 * lanes + 4 * 128))
+    return max(512, min(8192, blk // 8 * 8))
 
 
 def fused_supported(n: int, f: int, k: int) -> bool:
@@ -89,7 +106,6 @@ def _lloyd_kernel(
     csq_ref,
     cT_ref,
     nvalid_ref,
-    lab_ref,
     sums_ref,
     counts_ref,
     inertia_ref,
@@ -104,7 +120,13 @@ def _lloyd_kernel(
     can carry its own count."""
     i = pl.program_id(0)
 
-    # 2-D iotas: Mosaic does not lower 1-D iota
+    # EVERY intermediate stays 2-D. Mosaic lays a 1-D (block,) value out as
+    # vector<1xblockxf32> with a replicated sublane, and chaining argmin /
+    # where / reduce through that layout hits "Invalid relayout: Non-singleton
+    # logical dimension is replicated in destination but not in source"
+    # (observed on a real v5e at block=8192; benchmarks/TPU_WINDOW_r04.json
+    # mosaic_variants passes each construct alone — only the 1-D chain fails).
+    # keepdims=True everywhere sidesteps the layout class entirely.
     klane = jax.lax.broadcasted_iota(jnp.int32, (1, k), 1)
     rows = i * block + jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0)
     valid_b = rows < nvalid_ref[0, 0]  # (BLOCK, 1) bool
@@ -120,10 +142,8 @@ def _lloyd_kernel(
     score = csq_ref[:, :] - 2.0 * jnp.dot(
         xb, cT_ref[:, :], preferred_element_type=jnp.float32
     )
-    labels = jnp.argmin(score, axis=1).astype(jnp.int32)  # (block,)
-    lab_ref[:, :] = labels[:, None]
-
-    onehot = (labels[:, None] == klane).astype(xb.dtype) * valid  # (BLOCK, k)
+    labels2d = jnp.argmin(score, axis=1, keepdims=True).astype(jnp.int32)  # (block, 1)
+    onehot = (labels2d == klane).astype(xb.dtype) * valid  # (BLOCK, k)
 
     @pl.when(i == 0)
     def _init():
@@ -134,10 +154,11 @@ def _lloyd_kernel(
     sums_ref[:, :] += jnp.dot(onehot.T, xb, preferred_element_type=jnp.float32).astype(
         sums_ref.dtype
     )
-    counts_ref[:, :] += jnp.sum(onehot, axis=0, dtype=counts_ref.dtype)[None, :]
+    counts_ref[:, :] += jnp.sum(onehot, axis=0, keepdims=True).astype(counts_ref.dtype)
     # where, not multiply: even a finite-but-garbage pad score must not leak,
     # and NaN·0 = NaN would defeat a multiplicative mask
-    masked_min = jnp.where(valid_b[:, 0], jnp.min(score, axis=1), 0.0)
+    min2d = jnp.min(score, axis=1, keepdims=True)  # (block, 1)
+    masked_min = jnp.where(valid_b, min2d, 0.0)  # (block, 1)
     inertia_ref[:, :] += jnp.sum(masked_min, dtype=inertia_ref.dtype)[None, None]
 
 
@@ -146,8 +167,9 @@ def _kernel_call(data, centers, k: int, n_valid, interpret: bool):
 
     ``n_valid`` is a traced int32 scalar: rows at local index >= n_valid are
     masked out of the accumulators (tail padding; under shard_map, each
-    device's share of the global pad). Returns the raw (labels2d, sums,
-    counts, inertia) outputs.
+    device's share of the global pad). Returns the raw (sums, counts,
+    inertia) accumulators — labels are deliberately NOT a kernel output
+    (see the module docstring on lane padding).
     """
     n, f = data.shape
     # downcast BEFORE deriving cT so the kernel never mixes f64 operands
@@ -164,7 +186,6 @@ def _kernel_call(data, centers, k: int, n_valid, interpret: bool):
     return pl.pallas_call(
         functools.partial(_lloyd_kernel, k=k, block=block),
         out_shape=(
-            jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
             jax.ShapeDtypeStruct((k, f), jnp.float32),
             jax.ShapeDtypeStruct((1, k), jnp.float32),
             jax.ShapeDtypeStruct((1, 1), jnp.float32),
@@ -177,7 +198,6 @@ def _kernel_call(data, centers, k: int, n_valid, interpret: bool):
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=(
-            pl.BlockSpec((block, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((k, f), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
@@ -186,29 +206,41 @@ def _kernel_call(data, centers, k: int, n_valid, interpret: bool):
     )(x, csq, cT, nv)
 
 
+def _assign_labels(data: jax.Array, centers: jax.Array) -> jax.Array:
+    """The assignment step alone, as one fused XLA pass: labels w.r.t.
+    ``centers``. Runs ONCE per program as the label epilogue — per-row labels
+    are not a kernel output (module docstring)."""
+    x32 = data.astype(jnp.float32)
+    c32 = centers.astype(jnp.float32)
+    score = jnp.sum(c32 * c32, axis=1)[None, :] - 2.0 * (x32 @ c32.T)
+    return jnp.argmin(score, axis=1).astype(jnp.int32)
+
+
 @functools.partial(jax.jit, static_argnames=("k", "interpret"))
 def fused_lloyd_iter(
     data: jax.Array, centers: jax.Array, k: int, xsq_sum=None, interpret: bool = False
 ):
-    """One Lloyd iteration in a single data pass.
+    """One Lloyd iteration in a single accumulator pass (+ label epilogue).
 
     Returns ``(new_centers, labels, inertia, shift)`` with the same contract
-    as ``cluster.kmeans._lloyd_iter`` (inertia includes the Σ|x|² term).
+    as ``cluster.kmeans._lloyd_iter`` (inertia includes the Σ|x|² term;
+    labels are the assignment against the INPUT centers).
     ``xsq_sum`` is the loop-invariant Σ|x|²; pass it from outside an
     iteration loop, or it is computed here (costing the one extra data read
     the kernel exists to avoid).
     """
     n = data.shape[0]
-    labels2d, sums, counts, inertia = _kernel_call(
+    sums, counts, inertia = _kernel_call(
         data, centers, k, jnp.asarray(n, jnp.int32), interpret
     )
     if xsq_sum is None:
         x32 = data.astype(jnp.float32)
         xsq_sum = jnp.sum(x32 * x32)
-    return _finalize(labels2d[:n, 0], sums, counts, inertia, centers, xsq_sum)
+    new_centers, inertia_full, shift = _finalize(sums, counts, inertia, centers, xsq_sum)
+    return new_centers, _assign_labels(data, centers), inertia_full, shift
 
 
-def _finalize(labels, sums, counts, inertia, centers, xsq_sum):
+def _finalize(sums, counts, inertia, centers, xsq_sum):
     """Shared epilogue: centroid update (empty clusters keep their center),
     inertia restoration (+Σ|x|²), and the convergence shift. One body for
     the single-device and sharded paths so their numerics cannot drift."""
@@ -220,7 +252,7 @@ def _finalize(labels, sums, counts, inertia, centers, xsq_sum):
     ).astype(centers.dtype)
     inertia_full = jnp.maximum(inertia[0, 0] + xsq_sum, 0.0)
     shift = jnp.sum((new_centers - centers).astype(jnp.float32) ** 2)
-    return new_centers, labels, inertia_full, shift
+    return new_centers, inertia_full, shift
 
 
 @functools.partial(jax.jit, static_argnames=("k", "n_steps", "interpret"))
@@ -228,18 +260,27 @@ def fused_lloyd_run(
     data: jax.Array, centers: jax.Array, k: int, n_steps: int, interpret: bool = False
 ):
     """``n_steps`` fused iterations in one XLA program (the pallas analog of
-    ``cluster.kmeans._lloyd_run``): Σ|x|² hoisted, one kernel pass per step."""
+    ``cluster.kmeans._lloyd_run``): Σ|x|² hoisted, one kernel pass per step,
+    labels from ONE epilogue pass against the last iteration's input centers
+    (the jnp oracle's exact label convention)."""
     x32 = data.astype(jnp.float32)
     xsq_sum = jnp.sum(x32 * x32)
 
     def body(i, carry):
         centers, _, _, _ = carry
-        return fused_lloyd_iter(data, centers, k, xsq_sum=xsq_sum, interpret=interpret)
+        sums, counts, inertia = _kernel_call(
+            data, centers, k, jnp.asarray(data.shape[0], jnp.int32), interpret
+        )
+        new_centers, inertia_full, shift = _finalize(
+            sums, counts, inertia, centers, xsq_sum
+        )
+        return (new_centers, centers, inertia_full, shift)
 
     acc = jnp.zeros((), jnp.float32)
-    return jax.lax.fori_loop(
-        0, n_steps, body, (centers, jnp.zeros(data.shape[0], jnp.int32), acc, acc)
+    centers, used, inertia, shift = jax.lax.fori_loop(
+        0, n_steps, body, (centers, centers, acc, acc)
     )
+    return centers, _assign_labels(data, used), inertia, shift
 
 
 def fused_lloyd_iter_sharded(
@@ -257,7 +298,9 @@ def fused_lloyd_iter_sharded(
     multiple of the mesh size, suffix-padded when the logical ``n_global``
     is ragged. Each device runs the single-pass kernel on its own block —
     masking its share of the global padding — and the (k, f)/(k,)/scalar
-    accumulators merge with one ``psum``. Labels come back sliced to the
+    accumulators merge with one ``psum``. Labels come from the shared jnp
+    epilogue on the row-sharded global view (no collectives: the matmul
+    against replicated centers and the argmin are row-local), sliced to the
     logical length ``n_global``.
 
     Same return contract as :func:`fused_lloyd_iter`. The whole iteration
@@ -277,21 +320,21 @@ def _sharded_iter_fn(mesh, axis, k, n_global, interpret):
         local_rows = xl.shape[0]
         idx = jax.lax.axis_index(axis)
         local_valid = jnp.clip(n_global - idx * local_rows, 0, local_rows)
-        labels2d, sums, counts, inertia = _kernel_call(xl, c, k, local_valid, interpret)
+        sums, counts, inertia = _kernel_call(xl, c, k, local_valid, interpret)
         sums = jax.lax.psum(sums, axis)
         counts = jax.lax.psum(counts, axis)
         inertia = jax.lax.psum(inertia, axis)
-        return labels2d[:local_rows], sums, counts, inertia
+        return sums, counts, inertia
 
     def step(data, centers, xsq_sum):
-        labels2d, sums, counts, inertia = jax.shard_map(
+        sums, counts, inertia = jax.shard_map(
             device_step,
             mesh=mesh,
             in_specs=(P(axis, None), P()),
-            out_specs=(P(axis, None), P(), P(), P()),
+            out_specs=(P(), P(), P()),
             check_vma=False,  # pallas_call outputs carry no vma annotation
         )(data, centers)
-        return _finalize(labels2d[:n_global, 0], sums, counts, inertia, centers, xsq_sum)
+        return _finalize(sums, counts, inertia, centers, xsq_sum)
 
     return step
 
@@ -314,7 +357,9 @@ def _sharded_fn(mesh, axis, p, k, n_global, interpret):
     def run(data, centers, xsq_sum):
         if xsq_sum is None:
             xsq_sum = _logical_xsq_sum(data, n_global)
-        return step(data, centers, xsq_sum)
+        new_centers, inertia, shift = step(data, centers, xsq_sum)
+        labels = _assign_labels(data, centers)[:n_global]
+        return new_centers, labels, inertia, shift
 
     return run
 
@@ -347,15 +392,15 @@ def _sharded_run_fn(mesh, axis, p, k, n_global, n_steps, interpret):
 
         def body(i, carry):
             c = carry[0]
-            return step(data, c, xsq_sum)
+            new_c, inertia, shift = step(data, c, xsq_sum)
+            return (new_c, c, inertia, shift)
 
         acc = jnp.zeros((), jnp.float32)
-        init = (
-            centers.astype(jnp.float32),
-            jnp.zeros(n_global, jnp.int32),
-            acc,
-            acc,
+        c0 = centers.astype(jnp.float32)
+        new_c, used, inertia, shift = jax.lax.fori_loop(
+            0, n_steps, body, (c0, c0, acc, acc)
         )
-        return jax.lax.fori_loop(0, n_steps, body, init)
+        labels = _assign_labels(data, used)[:n_global]
+        return new_c, labels, inertia, shift
 
     return run

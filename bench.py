@@ -323,17 +323,22 @@ def worker() -> None:
     try:
         # same kernel as the primary 1x run — subtracting across different
         # kernels would make the marginal rate (and the roofline fields fed
-        # from it) meaningless
-        _, _, _, shift3 = _primary_run(3 * ITERS)
-        float(shift3)  # compile
-        best3 = float("inf")
+        # from it) meaningless. 10x (not 3x): the measured per-program fixed
+        # cost through the tunnel is ~67 ms against ~0.9 ms/iter, so a 3x
+        # spread is noise-level while 10x puts ~9 fixed costs of daylight
+        # between the two points.
+        _, _, _, shift10 = _primary_run(10 * ITERS)
+        float(shift10)  # compile
+        best10 = float("inf")
         for _ in range(2):
             start = time.perf_counter()
-            _, _, _, shift3 = _primary_run(3 * ITERS)
-            float(shift3)
-            best3 = min(best3, time.perf_counter() - start)
-        if best3 >= 1.5 * best:
-            record["lloyd_iters_per_sec_marginal"] = round((3 * ITERS - ITERS) / (best3 - best), 3)
+            _, _, _, shift10 = _primary_run(10 * ITERS)
+            float(shift10)
+            best10 = min(best10, time.perf_counter() - start)
+        if best10 > best:
+            marg = (best10 - best) / (9 * ITERS)
+            record["lloyd_iters_per_sec_marginal"] = round(1.0 / marg, 3)
+            record["lloyd_fixed_ms"] = round((best - ITERS * marg) * 1e3, 1)
     except Exception:  # noqa: BLE001 - diagnostics must never cost the record
         pass
 

@@ -1,4 +1,4 @@
-"""Fused single-pass Lloyd iteration (pallas).
+"""Fused single-pass Lloyd iteration (pallas), in samples-in-lanes layout.
 
 The jnp Lloyd step (`cluster/kmeans.py:_lloyd_iter`) necessarily reads the
 (n, f) data from HBM twice per iteration — once for the assignment matmul
@@ -7,33 +7,29 @@ the (n, k) one-hot operand for the MXU. At the benchmark shape (10M x 16
 f32) the iteration is pure HBM bandwidth, so the floor is set by bytes
 moved, not FLOPs.
 
-This kernel streams each row block into VMEM ONCE and produces everything
-the iteration needs in that single pass:
+This kernel streams each sample block into VMEM ONCE and produces everything
+the iteration needs in that single pass. Crucially it operates on the
+TRANSPOSED operand ``xT (f, n)`` — features in sublanes, samples in lanes:
 
-    score   = |c|² − 2·xb @ cᵀ          (block, k)   MXU
-    labels  = argmin(score)              (block,)
-    inertia += Σ min(score)              scalar accumulator
-    onehot  = (labels == arange(k))      (block, k)  VMEM-only
-    sums   += onehotᵀ @ xb               (k, f)      MXU accumulator
-    counts += Σ onehot                   (k,)        accumulator
+    score   = |c|² − 2·c @ xb           (k, block)   MXU
+    labels  = argmin₀(score)             (1, block)  sublane reduce
+    inertia += Σ min₀(score)             scalar accumulator
+    onehot  = (labels == iota_k)         (k, block)  VMEM-only
+    sumsᵀ  += xb ·ₗ onehot               (f, k)      MXU (lane contraction)
+    counts += Σₗ onehot                  (k, 1)      accumulator
 
-HBM traffic per iteration: n·f reads, and NOTHING per-row written — the
-kernel emits only the (k, f)/(1, k)/(1, 1) accumulators. Labels are not an
-iteration output at all: a ``(block, 1)`` label block lane-pads 1 → 128 in
-VMEM (it cost 8 MB of the 16 MB scoped budget — the r04 OOM) and a
-``(n, 1)`` array tiles to ~128x its size in HBM, so per-iteration label
-writes are exactly the waste a TPU-first design must avoid. The final
-assignment is a separate fused jnp epilogue (`_assign_labels`) executed
-once per program against the centers of the last iteration — the same
-labels the jnp oracle reports, at the cost of one extra data read per
-*program* (≤8 iterations), not per iteration. This is ~2x less traffic
-than the fused-by-XLA jnp path (which cannot merge two contractions over
-the same operand into one read). The centroid update (k x f, tiny) runs
-outside.
-
-The feature axis is NOT padded to the 128-lane width in HBM — blocks are
-DMA'd as (block, f) and padded only in VMEM — so the bandwidth advantage
-survives small f (f=16 padded in HBM would octuple the bytes).
+Why transposed: TPU vector memory pads the MINOR axis to 128 lanes. In the
+natural (block, f) layout a narrow f (the benchmark's f=16) pads 8x — the
+kernel was measured on a real v5e moving ~5 GB per iteration against the
+jnp path's 1.3 GB, a 0.34x "speedup". With samples in lanes the minor axis
+is the long one (no padding, any f), the sublane axis is f (padded to 8),
+and every reduction in the kernel is lane-preserving. The one-time
+``transpose`` to (f, n) costs one data pass and is hoisted out of the
+iteration loop; per-iteration HBM traffic is n·f reads and NOTHING
+per-row written (labels are not an iteration output at all — a separate
+fused jnp epilogue computes the final assignment once per program, against
+the centers of the last iteration, which is the jnp oracle's exact label
+convention).
 
 This kernel IS the product path: ``cluster.KMeans.fit`` dispatches here on
 TPU (``fused_supported`` / ``fused_sharded_supported``), keeping the jnp
@@ -43,9 +39,11 @@ record), with the other path alongside (``lloyd_jnp_iters_per_sec`` /
 ``lloyd_fused_vs_jnp``). :func:`fused_lloyd_iter` is
 single-device (its pallas_call has no partitioning spec);
 :func:`fused_lloyd_iter_sharded` / :func:`fused_lloyd_run_sharded` are the
-multi-chip forms: a shard_map wrapper running the kernel per device and
-merging the (k, f) accumulators with one psum — the exact collective budget
-of the jnp path.
+multi-chip forms: a shard_map running the kernel per device and merging the
+(f, k)/(k, 1)/scalar accumulators with one psum per iteration — the exact
+collective budget of the jnp path. In the sharded run the whole fori_loop
+lives INSIDE the shard_map so the per-device transpose is paid once per
+program, not once per iteration.
 """
 
 from __future__ import annotations
@@ -66,23 +64,25 @@ __all__ = [
     "fused_supported",
 ]
 
-def _block_rows(f: int) -> int:
-    """Rows per grid step, sized against the REAL scoped-VMEM footprint on a
-    v5e (16 MB limit). Everything row-shaped is lane-padded to a multiple of
-    128: the double-buffered (block, f) input AND the kernel's live vector
-    intermediates — xb, score, onehot and the masked-min chain all occupy
-    block x 128 lanes of stack regardless of f or k. Budget ≈ 4 · block ·
-    (2 · lane_pad(f) + 4 · 128) bytes ≤ 12 MB (headroom for the (k, f)
-    accumulators and csq/cT). Measured: block=8192 at f=16 hit the 16 MB
-    scoped limit to within 1.5 KB even with NO per-row output."""
-    lanes = 128 * ((f + 127) // 128)
-    blk = (12 << 20) // (4 * (2 * lanes + 4 * 128))
-    return max(512, min(8192, blk // 8 * 8))
+
+def _block_cols(f: int, k: int) -> int:
+    """Samples (lanes) per grid step, sized against the scoped-VMEM budget
+    on a v5e (16 MB limit). Live vectors per lane: the double-buffered
+    (f, block) input plus the (k, block)-shaped score/onehot/min chain —
+    all sublane-padded to multiples of 8. Budget ≤ 12 MB leaves headroom
+    for the (f, k)/(k, 1) accumulators and c/csq. (An earlier (block, f)
+    kernel ignored lane padding and hit the 16 MB scoped limit to within
+    1.5 KB; this sizing is measured, not aspirational.)"""
+    fp = 8 * ((f + 7) // 8)
+    kp = 8 * ((k + 7) // 8)
+    per_lane = 4 * (2 * fp + 3 * kp + 8)
+    blk = (12 << 20) // per_lane
+    return max(1024, min(65536, blk // 128 * 128))
 
 
 def fused_supported(n: int, f: int, k: int) -> bool:
     """TPU backend, single device (the kernel has no partitioning spec —
-    a sharded operand would be gathered), and lane-safe k."""
+    a sharded operand would be gathered), and sublane-safe f/k."""
     try:
         backend_ok = jax.default_backend() in ("tpu", "axon")
         single = len(jax.devices()) == 1
@@ -92,7 +92,7 @@ def fused_supported(n: int, f: int, k: int) -> bool:
 
 
 def fused_sharded_supported(f: int, k: int) -> bool:
-    """TPU backend and lane-safe shapes; device count is irrelevant (the
+    """TPU backend and sublane-safe shapes; device count is irrelevant (the
     shard_map wrapper runs the kernel per device)."""
     try:
         backend_ok = jax.default_backend() in ("tpu", "axon")
@@ -102,108 +102,120 @@ def fused_sharded_supported(f: int, k: int) -> bool:
 
 
 def _lloyd_kernel(
-    x_ref,
+    xT_ref,
     csq_ref,
-    cT_ref,
+    c_ref,
     nvalid_ref,
-    sums_ref,
+    sumsT_ref,
     counts_ref,
     inertia_ref,
     *,
     k: int,
     block: int,
 ):
-    """One (block, f) row block; accumulators live across the whole grid.
-    Rows at index >= nvalid (tail padding: ragged sizes, or a device's share
-    of the global padding under the sharded wrapper) are masked out of every
-    accumulator. n_valid is a runtime (1,1) scalar operand so each device
-    can carry its own count."""
+    """One (f, block) sample block; accumulators live across the whole grid.
+    Samples at column index >= nvalid (tail padding: ragged sizes, or a
+    device's share of the global padding under the sharded wrapper) are
+    masked out of every accumulator. n_valid is a runtime (1, 1) scalar
+    operand so each device can carry its own count.
+
+    Every intermediate is 2-D: Mosaic lays a 1-D (block,) value out with a
+    replicated sublane and chaining argmin / where / reduce through that
+    layout hits "Invalid relayout: non-singleton logical dimension is
+    replicated in destination but not in source" (observed on a real v5e;
+    benchmarks/TPU_WINDOW_r04.json mosaic_variants passes each construct
+    alone — only the 1-D chain fails)."""
     i = pl.program_id(0)
 
-    # EVERY intermediate stays 2-D. Mosaic lays a 1-D (block,) value out as
-    # vector<1xblockxf32> with a replicated sublane, and chaining argmin /
-    # where / reduce through that layout hits "Invalid relayout: Non-singleton
-    # logical dimension is replicated in destination but not in source"
-    # (observed on a real v5e at block=8192; benchmarks/TPU_WINDOW_r04.json
-    # mosaic_variants passes each construct alone — only the 1-D chain fails).
-    # keepdims=True everywhere sidesteps the layout class entirely.
-    klane = jax.lax.broadcasted_iota(jnp.int32, (1, k), 1)
-    rows = i * block + jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0)
-    valid_b = rows < nvalid_ref[0, 0]  # (BLOCK, 1) bool
+    cols = i * block + jax.lax.broadcasted_iota(jnp.int32, (1, block), 1)
+    valid = cols < nvalid_ref[0, 0]  # (1, block) bool
 
     # Pad-region content is UNSPECIFIED (dndarray.parray contract) — inf/NaN
     # there would poison the accumulators through 0·inf = NaN in the sums
-    # matmul, so zero invalid rows rather than relying on multiplicative
-    # masking downstream.
-    xb = jnp.where(valid_b, x_ref[:, :], 0)  # (block, f)
-    valid = valid_b.astype(xb.dtype)
+    # contraction, so zero invalid samples rather than relying on
+    # multiplicative masking downstream.
+    xb = jnp.where(valid, xT_ref[:, :], 0)  # (f, block)
 
-    # (block, k) assignment scores; |x|² omitted (row-constant for argmin)
+    # (k, block) assignment scores; |x|² omitted (sample-constant for argmin)
     score = csq_ref[:, :] - 2.0 * jnp.dot(
-        xb, cT_ref[:, :], preferred_element_type=jnp.float32
+        c_ref[:, :], xb, preferred_element_type=jnp.float32
     )
-    labels2d = jnp.argmin(score, axis=1, keepdims=True).astype(jnp.int32)  # (block, 1)
-    onehot = (labels2d == klane).astype(xb.dtype) * valid  # (BLOCK, k)
+    kcol = jax.lax.broadcasted_iota(jnp.int32, (k, 1), 0)
+    labels = jnp.argmin(score, axis=0, keepdims=True).astype(jnp.int32)  # (1, block)
+    onehot = (labels == kcol).astype(xb.dtype) * valid.astype(xb.dtype)  # (k, block)
 
     @pl.when(i == 0)
     def _init():
-        sums_ref[:, :] = jnp.zeros_like(sums_ref)
+        sumsT_ref[:, :] = jnp.zeros_like(sumsT_ref)
         counts_ref[:, :] = jnp.zeros_like(counts_ref)
         inertia_ref[:, :] = jnp.zeros_like(inertia_ref)
 
-    sums_ref[:, :] += jnp.dot(onehot.T, xb, preferred_element_type=jnp.float32).astype(
-        sums_ref.dtype
-    )
-    counts_ref[:, :] += jnp.sum(onehot, axis=0, keepdims=True).astype(counts_ref.dtype)
+    # sumsᵀ (f, k): contract the lane (sample) axes of both operands on the
+    # MXU — dot_general, so the (k, block) onehot is never transposed
+    sumsT_ref[:, :] += jax.lax.dot_general(
+        xb, onehot, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ).astype(sumsT_ref.dtype)
+    counts_ref[:, :] += jnp.sum(onehot, axis=1, keepdims=True).astype(counts_ref.dtype)
     # where, not multiply: even a finite-but-garbage pad score must not leak,
     # and NaN·0 = NaN would defeat a multiplicative mask
-    min2d = jnp.min(score, axis=1, keepdims=True)  # (block, 1)
-    masked_min = jnp.where(valid_b, min2d, 0.0)  # (block, 1)
+    min2d = jnp.min(score, axis=0, keepdims=True)  # (1, block)
+    masked_min = jnp.where(valid, min2d, 0.0)  # (1, block)
     inertia_ref[:, :] += jnp.sum(masked_min, dtype=inertia_ref.dtype)[None, None]
 
 
-def _kernel_call(data, centers, k: int, n_valid, interpret: bool):
-    """Pad, tile, and invoke the kernel on one device's rows.
-
-    ``n_valid`` is a traced int32 scalar: rows at local index >= n_valid are
-    masked out of the accumulators (tail padding; under shard_map, each
-    device's share of the global pad). Returns the raw (sums, counts,
-    inertia) accumulators — labels are deliberately NOT a kernel output
-    (see the module docstring on lane padding).
-    """
-    n, f = data.shape
-    # downcast BEFORE deriving cT so the kernel never mixes f64 operands
-    # (Mosaic cannot lower f64; interpret/CPU would silently promote)
-    x = data.astype(jnp.float32) if data.dtype == jnp.float64 else data
-    csq = jnp.sum(centers * centers, axis=1, dtype=jnp.float32)[None, :]  # (1, k)
-    cT = centers.T.astype(x.dtype)  # (f, k)
-    block = _block_rows(f)
+def _prepare(data: jax.Array, block: int) -> jax.Array:
+    """(n, f) -> (f, n_pad) f32: transpose to samples-in-lanes and pad the
+    sample axis to a block multiple. One data pass; loop-invariant, so XLA
+    hoists it out of an enclosing fori_loop."""
+    x = data.astype(jnp.float32)
+    n = x.shape[0]
     n_pad = -(-n // block) * block
+    xT = jnp.transpose(x)
     if n_pad != n:
-        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
+        xT = jnp.pad(xT, ((0, 0), (0, n_pad - n)))
+    return xT
+
+
+def _kernel_call_T(xT, centers, k: int, n_valid, interpret: bool):
+    """Invoke the kernel on a prepared (f, n_pad) operand. Returns the raw
+    (sumsT, counts, inertia) accumulators — labels are deliberately NOT a
+    kernel output (see the module docstring on lane padding)."""
+    f, n_pad = xT.shape
+    block = _block_cols(f, k)
+    assert n_pad % block == 0, (n_pad, block)
+    c32 = centers.astype(jnp.float32)
+    csq = jnp.sum(c32 * c32, axis=1, keepdims=True)  # (k, 1)
     nv = jnp.reshape(n_valid.astype(jnp.int32), (1, 1))
 
     return pl.pallas_call(
         functools.partial(_lloyd_kernel, k=k, block=block),
         out_shape=(
-            jax.ShapeDtypeStruct((k, f), jnp.float32),
-            jax.ShapeDtypeStruct((1, k), jnp.float32),
+            jax.ShapeDtypeStruct((f, k), jnp.float32),
+            jax.ShapeDtypeStruct((k, 1), jnp.float32),
             jax.ShapeDtypeStruct((1, 1), jnp.float32),
         ),
         grid=(n_pad // block,),
         in_specs=[
-            pl.BlockSpec((block, f), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((f, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((f, block), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, f), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=(
-            pl.BlockSpec((k, f), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((f, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ),
         interpret=interpret,
-    )(x, csq, cT, nv)
+    )(xT, csq, c32, nv)
+
+
+def _kernel_call(data, centers, k: int, n_valid, interpret: bool):
+    """Pad, transpose, and invoke the kernel on one device's rows — the
+    (n, f)-in convenience form (single calls and tests; iteration loops use
+    :func:`_prepare` + :func:`_kernel_call_T` so the transpose hoists)."""
+    xT = _prepare(data, _block_cols(data.shape[1], k))
+    return _kernel_call_T(xT, centers, k, n_valid, interpret)
 
 
 def _assign_labels(data: jax.Array, centers: jax.Array) -> jax.Array:
@@ -230,21 +242,24 @@ def fused_lloyd_iter(
     the kernel exists to avoid).
     """
     n = data.shape[0]
-    sums, counts, inertia = _kernel_call(
+    sumsT, counts, inertia = _kernel_call(
         data, centers, k, jnp.asarray(n, jnp.int32), interpret
     )
     if xsq_sum is None:
         x32 = data.astype(jnp.float32)
         xsq_sum = jnp.sum(x32 * x32)
-    new_centers, inertia_full, shift = _finalize(sums, counts, inertia, centers, xsq_sum)
+    new_centers, inertia_full, shift = _finalize(
+        sumsT, counts, inertia, centers, xsq_sum
+    )
     return new_centers, _assign_labels(data, centers), inertia_full, shift
 
 
-def _finalize(sums, counts, inertia, centers, xsq_sum):
+def _finalize(sumsT, counts, inertia, centers, xsq_sum):
     """Shared epilogue: centroid update (empty clusters keep their center),
     inertia restoration (+Σ|x|²), and the convergence shift. One body for
     the single-device and sharded paths so their numerics cannot drift."""
-    counts = counts[0]
+    counts = counts[:, 0]  # (k,)
+    sums = sumsT.T  # (k, f) — tiny
     new_centers = jnp.where(
         counts[:, None] > 0,
         sums / jnp.maximum(counts[:, None], 1.0),
@@ -260,19 +275,20 @@ def fused_lloyd_run(
     data: jax.Array, centers: jax.Array, k: int, n_steps: int, interpret: bool = False
 ):
     """``n_steps`` fused iterations in one XLA program (the pallas analog of
-    ``cluster.kmeans._lloyd_run``): Σ|x|² hoisted, one kernel pass per step,
-    labels from ONE epilogue pass against the last iteration's input centers
-    (the jnp oracle's exact label convention)."""
+    ``cluster.kmeans._lloyd_run``): Σ|x|² and the samples-in-lanes transpose
+    hoisted, one kernel pass per step, labels from ONE epilogue pass against
+    the last iteration's input centers (the jnp oracle's exact label
+    convention)."""
     x32 = data.astype(jnp.float32)
     xsq_sum = jnp.sum(x32 * x32)
+    xT = _prepare(data, _block_cols(data.shape[1], k))
+    n_valid = jnp.asarray(data.shape[0], jnp.int32)
 
     def body(i, carry):
         centers, _, _, _ = carry
-        sums, counts, inertia = _kernel_call(
-            data, centers, k, jnp.asarray(data.shape[0], jnp.int32), interpret
-        )
+        sumsT, counts, inertia = _kernel_call_T(xT, centers, k, n_valid, interpret)
         new_centers, inertia_full, shift = _finalize(
-            sums, counts, inertia, centers, xsq_sum
+            sumsT, counts, inertia, centers, xsq_sum
         )
         return (new_centers, centers, inertia_full, shift)
 
@@ -297,7 +313,7 @@ def fused_lloyd_iter_sharded(
     ``data`` is the PHYSICAL payload (``DNDarray.parray``): row count a
     multiple of the mesh size, suffix-padded when the logical ``n_global``
     is ragged. Each device runs the single-pass kernel on its own block —
-    masking its share of the global padding — and the (k, f)/(k,)/scalar
+    masking its share of the global padding — and the (f, k)/(k, 1)/scalar
     accumulators merge with one ``psum``. Labels come from the shared jnp
     epilogue on the row-sharded global view (no collectives: the matmul
     against replicated centers and the argmin are row-local), sliced to the
@@ -311,9 +327,9 @@ def fused_lloyd_iter_sharded(
 
 
 def _sharded_iter_fn(mesh, axis, k, n_global, interpret):
-    """Traced (data, centers, xsq_sum) -> iteration tuple over a row-sharded
-    physical payload — the shared body of the per-iteration and fused-run
-    sharded entry points."""
+    """Traced (data, centers, xsq_sum) -> (new_centers, inertia, shift) over
+    a row-sharded physical payload (single iteration; the fused-run form
+    keeps its loop inside the shard_map instead — see _sharded_run_fn)."""
     from jax.sharding import PartitionSpec as P
 
     def device_step(xl, c):
@@ -374,8 +390,10 @@ def fused_lloyd_run_sharded(
     interpret: bool = False,
 ):
     """``n_steps`` fused sharded iterations in ONE XLA program — the
-    multi-chip analog of :func:`fused_lloyd_run`: Σ|x|² hoisted once, a
-    ``fori_loop`` of single-pass kernel steps, one psum per step."""
+    multi-chip analog of :func:`fused_lloyd_run`: Σ|x|² hoisted once, the
+    fori_loop of single-pass kernel steps INSIDE the shard_map (so each
+    device's samples-in-lanes transpose is paid once per program), one psum
+    per step."""
     fn = _sharded_run_fn(
         comm.mesh, comm.axis_name, comm.size, k, int(n_global), int(n_steps), bool(interpret)
     )
@@ -384,23 +402,39 @@ def fused_lloyd_run_sharded(
 
 @functools.lru_cache(maxsize=None)
 def _sharded_run_fn(mesh, axis, p, k, n_global, n_steps, interpret):
-    step = _sharded_iter_fn(mesh, axis, k, n_global, interpret)
+    from jax.sharding import PartitionSpec as P
+
+    def device_run(xl, c0, xsq_sum):
+        local_rows = xl.shape[0]
+        idx = jax.lax.axis_index(axis)
+        local_valid = jnp.clip(n_global - idx * local_rows, 0, local_rows)
+        f = xl.shape[1]
+        xT = _prepare(xl, _block_cols(f, k))  # once per program, per device
+
+        def body(i, carry):
+            c, _, _, _ = carry
+            sumsT, counts, inertia = _kernel_call_T(xT, c, k, local_valid, interpret)
+            sumsT = jax.lax.psum(sumsT, axis)
+            counts = jax.lax.psum(counts, axis)
+            inertia = jax.lax.psum(inertia, axis)
+            new_c, inertia_full, shift = _finalize(sumsT, counts, inertia, c, xsq_sum)
+            return (new_c, c, inertia_full, shift)
+
+        acc = jnp.zeros((), jnp.float32)
+        c0 = c0.astype(jnp.float32)
+        return jax.lax.fori_loop(0, n_steps, body, (c0, c0, acc, acc))
 
     @jax.jit
     def run(data, centers):
         xsq_sum = _logical_xsq_sum(data, n_global)
-
-        def body(i, carry):
-            c = carry[0]
-            new_c, inertia, shift = step(data, c, xsq_sum)
-            return (new_c, c, inertia, shift)
-
-        acc = jnp.zeros((), jnp.float32)
-        c0 = centers.astype(jnp.float32)
-        new_c, used, inertia, shift = jax.lax.fori_loop(
-            0, n_steps, body, (c0, c0, acc, acc)
-        )
+        new_c, used, inertia, shift = jax.shard_map(
+            device_run,
+            mesh=mesh,
+            in_specs=(P(axis, None), P(), P()),
+            out_specs=(P(), P(), P(), P()),
+            check_vma=False,  # pallas_call outputs carry no vma annotation
+        )(data, centers, xsq_sum)
         labels = _assign_labels(data, used)[:n_global]
-        return new_c, labels, inertia, shift
+        return new_c.astype(centers.dtype), labels, inertia, shift
 
     return run

@@ -124,18 +124,17 @@ class TestFusedLloyd(TestCase):
         )
         from heat_tpu.ops.lloyd import _kernel_call
 
-        labels2d, sums, counts, inertia = jax.jit(
+        sumsT, counts, inertia = jax.jit(
             lambda d, c: _kernel_call(d, c, k, jnp.asarray(n, jnp.int32), True)
         )(jnp.asarray(poisoned), centers)
-        assert np.isfinite(np.asarray(sums)).all()
+        assert np.isfinite(np.asarray(sumsT)).all()
         assert np.isfinite(float(inertia[0, 0]))
 
         ref_c, ref_lab, ref_inertia, _ = jax.jit(_lloyd_iter, static_argnames="k")(
             jnp.asarray(data_np), centers, k
         )
-        np.testing.assert_array_equal(np.asarray(labels2d)[:n, 0], np.asarray(ref_lab))
-        got_counts = np.asarray(counts)[0]
-        assert got_counts.sum() == n  # no pad row counted
+        got_counts = np.asarray(counts)[:, 0]
+        assert got_counts.sum() == n  # no pad sample counted
 
     def test_sharded_wrapper_divisible(self):
         import jax.numpy as jnp

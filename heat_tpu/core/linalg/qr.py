@@ -52,15 +52,15 @@ def qr(
     tiles_per_proc: int = 1,
     calc_q: bool = True,
     overwrite_a: bool = False,
-    method: str = "tsqr",
+    method: str = "auto",
 ) -> QR:
     """Reduced QR decomposition of a 2-D DNDarray (reference qr.py:17-179).
 
     ``tiles_per_proc``/``overwrite_a`` are accepted for API parity; the TSQR /
     panel schedules have no tile-count knob and never mutate their input.
 
-    ``method``: ``"tsqr"`` (default — Householder-based, unconditionally
-    stable), ``"cholqr2"``, or ``"auto"``. CholeskyQR2 factors tall-skinny
+    ``method``: ``"auto"`` (default), ``"tsqr"`` (Householder-based,
+    unconditionally stable), or ``"cholqr2"``. CholeskyQR2 factors tall-skinny
     operands as R from ``chol(AᵀA)``, Q by triangular solve, repeated once
     for re-orthonormalization. Every FLOP is a matmul, so on TPU it runs on
     the MXU where Householder QR is mostly vector work; the price is a
@@ -72,9 +72,10 @@ def qr(
     split != 1 — the panel path's split-1 R layout must not depend on
     conditioning) and falls back to TSQR on the same breakdown probe
     instead of raising — the all-matmul speed when conditioning allows,
-    Householder stability when it does not. (TSQR stays the default until a
-    real-TPU capture shows the cholqr2 margin at benchmark shapes — see
-    bench.py's ``qr_cholqr2_tflops`` field.)
+    Householder stability when it does not. ``"auto"`` became the default
+    once a real-TPU capture showed the margin at the benchmark shape:
+    CholeskyQR2 1.29 TFLOP/s vs TSQR 0.19 — 6.7x
+    (benchmarks/TPU_WINDOW_r04.json, cholqr2 stage, v5e 2M x 256 f32).
     """
     sanitation.sanitize_in(a)
     if a.ndim != 2:

@@ -398,13 +398,39 @@ def stage_attention():
     )
     att_flops = 4.0 * B * H * S * S * D / 2
     out = {}
-    fl = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
-    best = _timeit(lambda: fl(q, k, v), lambda r: float(r[0, 0, 0, 0]))
-    out["flash_attn_causal_4k_tflops"] = round(att_flops / best / 1e12, 2)
-    dn = jax.jit(lambda q, k, v: dot_product_attention(q, k, v, causal=True))
-    best_d = _timeit(lambda: dn(q, k, v), lambda r: float(r[0, 0, 0, 0]))
-    out["dense_attn_causal_4k_tflops"] = round(att_flops / best_d / 1e12, 2)
-    out["flash_vs_dense_speedup"] = round(best_d / best, 2)
+
+    # two-point marginal (1 vs 8 chained evals in ONE program, the output
+    # feeding back as q so nothing hoists): the single-eval wall time is
+    # dominated by the ~67 ms tunnel fixed cost — at 4k it exceeds the
+    # attention compute itself, making raw TFLOP/s a tunnel metric
+    def chained(att, reps):
+        @jax.jit
+        def run(q, k, v):
+            def body(i, qq):
+                return att(qq, k, v).astype(qq.dtype)
+
+            return jax.lax.fori_loop(0, reps, body, q)
+
+        return run
+
+    for name, att in (
+        ("flash", lambda q, k, v: flash_attention(q, k, v, causal=True)),
+        ("dense", lambda q, k, v: dot_product_attention(q, k, v, causal=True)),
+    ):
+        one = chained(att, 1)
+        eight = chained(att, 8)
+        best = _timeit(lambda: one(q, k, v), lambda r: float(r[0, 0, 0, 0]))
+        best8 = _timeit(lambda: eight(q, k, v), lambda r: float(r[0, 0, 0, 0]), reps=2)
+        out[f"{name}_attn_causal_4k_tflops"] = round(att_flops / best / 1e12, 2)
+        if best8 > best:
+            marg = (best8 - best) / 7
+            out[f"{name}_attn_causal_4k_tflops_marginal"] = round(
+                att_flops / marg / 1e12, 2
+            )
+    f_m = out.get("flash_attn_causal_4k_tflops_marginal")
+    d_m = out.get("dense_attn_causal_4k_tflops_marginal")
+    if f_m and d_m:
+        out["flash_vs_dense_speedup"] = round(f_m / d_m, 2)
     return out
 
 

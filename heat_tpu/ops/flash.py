@@ -65,8 +65,13 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_k,
     else:
         nk_eff = jnp.int32(nk)
 
+    # online-softmax state is kept 2-D ((block_q, 1), not (block_q,)):
+    # Mosaic lays 1-D vectors out with a replicated sublane, and chaining
+    # max / exp / where through that layout costs a relayout per k-tile —
+    # the same layout class that broke the Lloyd kernel outright
+    # (ops/lloyd.py). keepdims everywhere keeps the loop relayout-free.
     def body(jk, carry):
-        m, l, acc = carry
+        m, l, acc = carry  # m, l: (block_q, 1)
         k0 = jk * block_k
         kb = k_ref[0, pl.ds(k0, block_k), :].astype(jnp.float32)  # (block_k, D)
         vb = v_ref[0, pl.ds(k0, block_k), :].astype(jnp.float32)
@@ -79,23 +84,23 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_k,
             q_ids = q_idx0 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             keep = keep & (q_ids >= k_ids)
         s = jnp.where(keep, s, _NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=1))
-        p = jnp.exp(s - m_new[:, None])  # fully-masked rows: exp(-1e30+1e30)=1? no: see below
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
         # rows with m_new == _NEG_INF are all-masked; zero their probabilities
-        p = jnp.where((m_new > _NEG_INF / 2)[:, None], p, 0.0)
-        alpha = jnp.exp(m - m_new)
-        l = alpha * l + p.sum(axis=1)
-        acc = alpha[:, None] * acc + jax.lax.dot_general(
+        p = jnp.where(m_new > _NEG_INF / 2, p, 0.0)
+        alpha = jnp.exp(m - m_new)  # (block_q, 1)
+        l = alpha * l + jnp.sum(p, axis=1, keepdims=True)
+        acc = alpha * acc + jax.lax.dot_general(
             p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         return m_new, l, acc
 
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
     a0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, a0))
     denom = jnp.where(l > 0, l, 1.0)
-    o_ref[0] = (acc / denom[:, None]).astype(o_ref.dtype)
+    o_ref[0] = (acc / denom).astype(o_ref.dtype)
 
 
 @functools.partial(

@@ -346,6 +346,47 @@ def stage_cholqr2():
     return out
 
 
+def stage_cdist():
+    """cdist marginal GB/s/chip: K chained evaluations in one program vs 1,
+    cancelling the tunnel fixed cost (the official r04 record salvaged
+    before bench.py's cdist diagnostics could run). The chain feeds a value
+    derived from each full result back into the operand so nothing hoists."""
+    import jax
+    import jax.numpy as jnp
+
+    from heat_tpu.spatial.distance import _euclidian_fast
+
+    n, f = 32768, 64
+    x = jax.random.normal(jax.random.PRNGKey(5), (n, f), jnp.float32)
+
+    def chained(reps):
+        @jax.jit
+        def run(x):
+            def body(i, carry):
+                d = _euclidian_fast(carry, carry)
+                return carry + d[0, 0] * 1e-12
+
+            return _euclidian_fast(
+                jax.lax.fori_loop(0, reps, body, x), x
+            )
+
+        return run
+
+    one, eight = chained(0), chained(7)
+    best1 = _timeit(lambda: one(x), lambda r: float(r[0, 0]))
+    best8 = _timeit(lambda: eight(x), lambda r: float(r[0, 0]), reps=2)
+    out = {"n": n}
+    # bytes per evaluation: the tile kernel reads x twice (row/col operands)
+    # and writes the n^2 result; the chain's carry add fuses into the tile
+    ev_bytes = (2.0 * n * f + n * n) * 4
+    out["cdist_gbps"] = round(ev_bytes / best1 / 1e9, 2)
+    if best8 > best1:
+        marg = (best8 - best1) / 7
+        out["cdist_gbps_marginal"] = round(ev_bytes / marg / 1e9, 2)
+        out["cdist_fixed_ms"] = round((best1 - marg) * 1e3, 1)
+    return out
+
+
 def stage_moments_diag():
     import jax
     import jax.numpy as jnp
@@ -496,6 +537,7 @@ STAGES = {
     "lloyd_full": stage_lloyd_full,
     "capability": stage_capability,
     "cholqr2": stage_cholqr2,
+    "cdist": stage_cdist,
     "moments_diag": stage_moments_diag,
     "attention": stage_attention,
     "train": stage_train,

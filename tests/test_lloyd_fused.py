@@ -136,6 +136,32 @@ class TestFusedLloyd(TestCase):
         got_counts = np.asarray(counts)[:, 0]
         assert got_counts.sum() == n  # no pad sample counted
 
+    def test_block_cols_lane_aligned_and_budgeted(self):
+        # samples-in-lanes sizing: lane-multiple blocks, bounded VMEM
+        # footprint (the r04 v5e capture OOM'd the 16 MB scoped budget by
+        # ignoring padding — this pins the corrected accounting)
+        from heat_tpu.ops.lloyd import _block_cols
+
+        for f in (2, 16, 128, 512):
+            for k in (2, 8, 128):
+                blk = _block_cols(f, k)
+                assert blk % 128 == 0
+                fp, kp = 8 * ((f + 7) // 8), 8 * ((k + 7) // 8)
+                live_bytes = 4 * blk * (2 * fp + 3 * kp + 8)
+                assert live_bytes <= (12 << 20) or blk == 1024
+
+    def test_prepare_transposes_and_pads(self):
+        import jax.numpy as jnp
+
+        from heat_tpu.ops.lloyd import _block_cols, _prepare
+
+        x = jnp.arange(10 * 3, dtype=jnp.float32).reshape(10, 3)
+        block = _block_cols(3, 2)
+        xT = _prepare(x, block)
+        assert xT.shape[0] == 3 and xT.shape[1] % block == 0
+        np.testing.assert_array_equal(np.asarray(xT[:, :10]), np.asarray(x).T)
+        np.testing.assert_array_equal(np.asarray(xT[:, 10:]), 0)
+
     def test_sharded_wrapper_divisible(self):
         import jax.numpy as jnp
 

@@ -77,13 +77,14 @@ def annotate_roofline(rec: dict) -> None:
         return
     peaks = _roofline_peaks(rec.get("platform", "tpu"))
     n = rec.get("n") or 0
-    # kmeans (config 3): HBM-bound. Bytes/iter = one data read per pass over
-    # the operand (+ label write); the fused pallas path does ONE pass, the
-    # jnp path two (assignment + update contractions).
+    # kmeans (config 3): HBM-bound. The fused pallas path reads the operand
+    # ONCE per iteration and writes nothing per-row (labels are a one-off
+    # epilogue, cancelled by the marginal); the jnp path reads twice
+    # (assignment + update contractions) and writes the label vector.
     rate = rec.get("lloyd_iters_per_sec_marginal") or rec.get("value")
     if rate and n:
-        passes = 1 if rec.get("lloyd_path") == "fused_pallas" else 2
-        iter_bytes = n * (F * 4 * passes + 4)
+        fused = rec.get("lloyd_path") == "fused_pallas"
+        iter_bytes = n * (F * 4 * (1 if fused else 2) + (0 if fused else 4))
         gbps = rate * iter_bytes / 1e9
         rec["lloyd_hbm_gbps"] = round(gbps, 1)
         rec["pct_hbm_roofline_kmeans"] = round(100.0 * gbps / peaks["hbm_gbps"], 1)

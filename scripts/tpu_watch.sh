@@ -1,0 +1,27 @@
+#!/bin/bash
+# Tunnel watcher: waits for the axon TPU tunnel to answer, then captures the
+# remaining round-4 window stages (attention marginals, cdist marginal) and
+# finishes with one fresh full bench.py so the official record carries the
+# dispatch-cost-cancelled roofline fields. Safe to re-run; exits after DONE.
+cd "$(dirname "$0")/.." || exit 1
+for i in $(seq 1 "${TPU_WATCH_TRIES:-40}"); do
+  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "=== tunnel up, attempt $i $(date -u +%H:%M:%S) ===" >> /tmp/tpu_watch.log
+    timeout 1200 python benchmarks/tpu_window.py \
+      --out benchmarks/TPU_WINDOW_r04.json --stages attention,cdist \
+      >> /tmp/tpu_watch.log 2>&1
+    if python - <<'PY'
+import json, sys
+d = json.load(open("benchmarks/TPU_WINDOW_r04.json"))
+ok = lambda s: isinstance(s, dict) and s and not any("error" in k for k in s)
+sys.exit(0 if ok(d.get("attention", {})) and ok(d.get("cdist", {})) else 1)
+PY
+    then
+      echo "=== stages banked, running fresh bench ===" >> /tmp/tpu_watch.log
+      timeout 2700 python bench.py >> /tmp/tpu_watch_bench.log 2>&1
+      echo DONE >> /tmp/tpu_watch.log
+      break
+    fi
+  fi
+  sleep 280
+done

@@ -475,17 +475,14 @@ def stage_attention():
     return out
 
 
-def stage_train():
-    """DP ResNet18 samples/s on the live chip (BASELINE config 5's TPU leg;
-    the DASO cadence sweep needs a multi-device mesh and stays on the CPU
-    matrix — benchmarks/TRAIN_THROUGHPUT_r04.json)."""
+def _train_one_model(model, name: str) -> dict:
     import numpy as np
     import jax.numpy as jnp
     import optax
 
     import heat_tpu as ht
     from heat_tpu.core.dndarray import _ensure_split
-    from heat_tpu.nn import DataParallel, ResNet18
+    from heat_tpu.nn import DataParallel
 
     comm = ht.get_comm()
     n_dev = comm.size
@@ -494,7 +491,7 @@ def stage_train():
     x_np = rng.standard_normal((batch, 32, 32, 3)).astype(np.float32)
     y_np = rng.integers(0, 10, size=batch).astype(np.int32)
 
-    dp = DataParallel(ResNet18(num_classes=10), comm=comm, optimizer=optax.sgd(0.05))
+    dp = DataParallel(model, comm=comm, optimizer=optax.sgd(0.05))
     dp.init(0, x_np[: max(n_dev, 2)])
     dp.train_step(x_np, y_np)  # compile
 
@@ -504,7 +501,7 @@ def stage_train():
 
     best = _timeit(lambda: one(), lambda r: r, reps=4)
     out = {
-        "model": "resnet18",
+        "model": name,
         "global_batch": batch,
         "devices": n_dev,
         "dp_samples_per_sec": round(batch / best, 1),
@@ -528,6 +525,23 @@ def stage_train():
     return out
 
 
+def stage_train():
+    """DP ResNet18 samples/s on the live chip (BASELINE config 5's TPU leg;
+    the DASO cadence sweep needs a multi-device mesh and stays on the CPU
+    matrix — benchmarks/TRAIN_THROUGHPUT_r04.json)."""
+    from heat_tpu.nn import ResNet18
+
+    return _train_one_model(ResNet18(num_classes=10), "resnet18")
+
+
+def stage_train50():
+    """DP ResNet-50 samples/s — BASELINE config 5 names ResNet-50/CIFAR
+    specifically; ResNet18 (stage_train) stays for cross-round continuity."""
+    from heat_tpu.nn import ResNet50
+
+    return _train_one_model(ResNet50(num_classes=10), "resnet50")
+
+
 STAGES = {
     "init": stage_init,
     "mosaic_probe": stage_mosaic_probe,
@@ -540,6 +554,7 @@ STAGES = {
     "cdist": stage_cdist,
     "moments_diag": stage_moments_diag,
     "attention": stage_attention,
+    "train50": stage_train50,
     "train": stage_train,
 }
 

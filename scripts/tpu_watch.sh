@@ -7,14 +7,18 @@ cd "$(dirname "$0")/.." || exit 1
 for i in $(seq 1 "${TPU_WATCH_TRIES:-40}"); do
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "=== tunnel up, attempt $i $(date -u +%H:%M:%S) ===" >> /tmp/tpu_watch.log
-    timeout 1200 python benchmarks/tpu_window.py \
-      --out benchmarks/TPU_WINDOW_r04.json --stages attention,cdist \
+    timeout 1800 python benchmarks/tpu_window.py \
+      --out benchmarks/TPU_WINDOW_r04.json --stages attention,cdist,train50 \
       >> /tmp/tpu_watch.log 2>&1
     if python - <<'PY'
 import json, sys
 d = json.load(open("benchmarks/TPU_WINDOW_r04.json"))
 ok = lambda s: isinstance(s, dict) and s and not any("error" in k for k in s)
-sys.exit(0 if ok(d.get("attention", {})) and ok(d.get("cdist", {})) else 1)
+sys.exit(
+    0
+    if ok(d.get("attention", {})) and ok(d.get("cdist", {})) and ok(d.get("train50", {}))
+    else 1
+)
 PY
     then
       echo "=== stages banked, running fresh bench ===" >> /tmp/tpu_watch.log

@@ -525,6 +525,53 @@ def _train_one_model(model, name: str) -> dict:
     return out
 
 
+def stage_attention_sweep():
+    """Marginal TFLOP/s across (block_q, block_k) tilings of the pallas flash
+    kernel at the 4k causal shape — picks the tile schedule the defaults
+    should use. Banked opportunistically (not in the watcher's success gate)."""
+    import jax
+    import jax.numpy as jnp
+
+    from heat_tpu.ops.flash import flash_attention_tpu
+
+    B, S, H, D = 1, 4096, 8, 128
+    q, k, v = (
+        jax.random.normal(kk, (B, S, H, D), jnp.float32)
+        for kk in jax.random.split(jax.random.PRNGKey(4), 3)
+    )
+    att_flops = 4.0 * B * H * S * S * D / 2
+    out = {}
+    best_rate, best_cfg = 0.0, None
+    for bq, bk in ((128, 128), (128, 512), (256, 256), (256, 512), (512, 512)):
+        def att(qq, kk_, vv, bq=bq, bk=bk):
+            return flash_attention_tpu(qq, kk_, vv, causal=True, block_q=bq, block_k=bk)
+
+        def chained(reps):
+            @jax.jit
+            def run(q, k, v):
+                def body(i, qq):
+                    return att(qq, k, v).astype(qq.dtype)
+
+                return att(jax.lax.fori_loop(0, reps, body, q), k, v)
+
+            return run
+
+        try:
+            one, more = chained(0), chained(7)
+            b1 = _timeit(lambda: one(q, k, v), lambda r: float(r[0, 0, 0, 0]), reps=2)
+            b8 = _timeit(lambda: more(q, k, v), lambda r: float(r[0, 0, 0, 0]), reps=2)
+            if b8 > b1:
+                rate = att_flops / ((b8 - b1) / 7) / 1e12
+                out[f"bq{bq}_bk{bk}_tflops_marginal"] = round(rate, 2)
+                if rate > best_rate:
+                    best_rate, best_cfg = rate, [bq, bk]
+        except Exception as exc:  # noqa: BLE001 - one bad tiling must not end the sweep
+            out[f"bq{bq}_bk{bk}_error"] = repr(exc)[:160]
+    if best_cfg:
+        out["best"] = {"block_q": best_cfg[0], "block_k": best_cfg[1], "tflops": round(best_rate, 2)}
+    return out
+
+
 def stage_train():
     """DP ResNet18 samples/s on the live chip (BASELINE config 5's TPU leg;
     the DASO cadence sweep needs a multi-device mesh and stays on the CPU
@@ -555,6 +602,7 @@ STAGES = {
     "moments_diag": stage_moments_diag,
     "attention": stage_attention,
     "train50": stage_train50,
+    "attention_sweep": stage_attention_sweep,
     "train": stage_train,
 }
 

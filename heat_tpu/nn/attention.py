@@ -172,9 +172,13 @@ def flash_attention(
     * ``'scan'`` — a ``lax.scan`` over key tiles; runs everywhere, fully
       differentiable, XLA schedules the tiles.
     * ``'pallas'`` — the hand-tiled TPU kernel (:mod:`heat_tpu.ops.flash`);
-      owns the (q, k) tile grid, skips above-diagonal tiles when causal
-      (measured 4.6x over dense at 4k context on v5e). Differentiable via a
-      custom VJP whose backward re-runs the scan path (same O(seq) memory).
+      owns the (q, k) tile grid, skips above-diagonal tiles when causal.
+      Its win over dense is memory class (O(seq) vs O(seq²)) first, speed
+      second: at 4k causal the v5e marginal rates are comparable to XLA's
+      dense attention (`benchmarks/TPU_WINDOW_r04.json` attention stage;
+      the attention_sweep stage tracks the tile schedule). Differentiable
+      via a custom VJP whose backward re-runs the scan path (same O(seq)
+      memory).
       ``block_size`` does not apply — the kernel picks its own 128-aligned
       tiles (pass ``block_q``/``block_k`` to
       :func:`heat_tpu.ops.flash.flash_attention_tpu` directly to tune them).

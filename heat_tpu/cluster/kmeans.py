@@ -149,12 +149,18 @@ class KMeans(_KCluster):
         centers = self._initialize_cluster_centers(x)
         mode, interpret = self._fused_mode(x)
         fdtype = jnp.promote_types(x.dtype.jax_type(), jnp.float32)
+        # bfloat16 stays bfloat16 through the fused kernel (half the HBM
+        # traffic of the f32 stream; accumulators are f32 inside) — the jnp
+        # path and the centroids always compute in at-least-f32
+        keep_bf16 = mode is not None and x.dtype.jax_type() == jnp.bfloat16
+        ddtype = x.dtype.jax_type() if keep_bf16 else fdtype
         if mode == "sharded":
             # the kernel masks each device's share of the global pad itself,
             # so it consumes the PHYSICAL payload
-            data = x.parray.astype(fdtype)
+            data = x.parray.astype(ddtype)
         else:
-            data = x.larray.astype(fdtype)
+            data = x.larray.astype(ddtype)
+        centers = jnp.asarray(centers, fdtype)
 
         # iterations run in fused chunks of up to 8 per dispatch; convergence
         # is checked at chunk boundaries (coarser than the reference's

@@ -155,7 +155,10 @@ def _lloyd_kernel(
     sumsT_ref[:, :] += jax.lax.dot_general(
         xb, onehot, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ).astype(sumsT_ref.dtype)
-    counts_ref[:, :] += jnp.sum(onehot, axis=1, keepdims=True).astype(counts_ref.dtype)
+    # accumulate the count in f32: a bf16 onehot sum saturates at 256
+    counts_ref[:, :] += jnp.sum(
+        onehot, axis=1, keepdims=True, dtype=counts_ref.dtype
+    )
     # where, not multiply: even a finite-but-garbage pad score must not leak,
     # and NaN·0 = NaN would defeat a multiplicative mask
     min2d = jnp.min(score, axis=0, keepdims=True)  # (1, block)
@@ -164,10 +167,15 @@ def _lloyd_kernel(
 
 
 def _prepare(data: jax.Array, block: int) -> jax.Array:
-    """(n, f) -> (f, n_pad) f32: transpose to samples-in-lanes and pad the
+    """(n, f) -> (f, n_pad): transpose to samples-in-lanes and pad the
     sample axis to a block multiple. One data pass; loop-invariant, so XLA
-    hoists it out of an enclosing fori_loop."""
-    x = data.astype(jnp.float32)
+    hoists it out of an enclosing fori_loop.
+
+    bfloat16 stays bfloat16 — the kernel's contractions accumulate in f32
+    (``preferred_element_type``) while the streamed operand keeps half the
+    HBM footprint, doubling the bandwidth-bound iteration rate. Everything
+    else (f64 included: Mosaic cannot lower it) is carried as f32."""
+    x = data if data.dtype == jnp.bfloat16 else data.astype(jnp.float32)
     n = x.shape[0]
     n_pad = -(-n // block) * block
     xT = jnp.transpose(x)
@@ -184,7 +192,10 @@ def _kernel_call_T(xT, centers, k: int, n_valid, interpret: bool):
     block = _block_cols(f, k)
     assert n_pad % block == 0, (n_pad, block)
     c32 = centers.astype(jnp.float32)
-    csq = jnp.sum(c32 * c32, axis=1, keepdims=True)  # (k, 1)
+    csq = jnp.sum(c32 * c32, axis=1, keepdims=True)  # (k, 1) — always f32
+    # the score dot's operands must share the streamed dtype (bf16 stays
+    # bf16 on the MXU; accumulation is f32 via preferred_element_type)
+    cx = c32.astype(xT.dtype)
     nv = jnp.reshape(n_valid.astype(jnp.int32), (1, 1))
 
     return pl.pallas_call(
@@ -207,7 +218,7 @@ def _kernel_call_T(xT, centers, k: int, n_valid, interpret: bool):
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ),
         interpret=interpret,
-    )(xT, csq, c32, nv)
+    )(xT, csq, cx, nv)
 
 
 def _kernel_call(data, centers, k: int, n_valid, interpret: bool):

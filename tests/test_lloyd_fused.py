@@ -136,6 +136,46 @@ class TestFusedLloyd(TestCase):
         got_counts = np.asarray(counts)[:, 0]
         assert got_counts.sum() == n  # no pad sample counted
 
+    def test_bf16_stream_matches_f32_oracle_loosely(self):
+        # bf16 operands stream as bf16 (half the HBM bytes); accumulators
+        # are f32, so centers/inertia track the f32 oracle to bf16 precision
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu.cluster.kmeans import _lloyd_iter
+        from heat_tpu.ops.lloyd import fused_lloyd_run
+
+        rng = np.random.default_rng(11)
+        n, f, k = 4096, 16, 4
+        data_np = rng.standard_normal((n, f)).astype(np.float32)
+        centers = jnp.asarray(rng.standard_normal((k, f)).astype(np.float32) * 2)
+        got = fused_lloyd_run(
+            jnp.asarray(data_np).astype(jnp.bfloat16), centers, k, 1, interpret=True
+        )
+        ref = jax.jit(_lloyd_iter, static_argnames="k")(jnp.asarray(data_np), centers, k)
+        np.testing.assert_allclose(
+            np.asarray(got[0], np.float32), np.asarray(ref[0]), rtol=0.05, atol=0.05
+        )
+        np.testing.assert_allclose(float(got[2]), float(ref[2]), rtol=0.05)
+        # labels come from the f32 epilogue: near-exact (ties aside)
+        assert (np.asarray(got[1]) == np.asarray(ref[1])).mean() > 0.97
+
+    def test_kmeans_fit_keeps_bf16_stream(self):
+        import jax.numpy as jnp
+
+        import heat_tpu as ht
+        from heat_tpu.cluster import KMeans
+
+        rng = np.random.default_rng(12)
+        x = ht.array(rng.standard_normal((600, 4)).astype(np.float32), split=0).astype(
+            ht.bfloat16
+        )
+        km = KMeans(n_clusters=3, max_iter=8, random_state=0, use_fused=True)
+        km.fit(x)
+        # centroids computed (and exposed) in at-least-f32
+        assert km.cluster_centers_.dtype in (ht.float32, ht.float64)
+        assert km.labels_.shape[0] == 600
+
     def test_block_cols_lane_aligned_and_budgeted(self):
         # samples-in-lanes sizing: lane-multiple blocks, bounded VMEM
         # footprint (the r04 v5e capture OOM'd the 16 MB scoped budget by

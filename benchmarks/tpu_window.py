@@ -589,6 +589,18 @@ def stage_train50():
     return _train_one_model(ResNet50(num_classes=10), "resnet50")
 
 
+def stage_train_bf16():
+    """Mixed-precision DP ResNet18 (bf16 compute, f32 params/grads — the
+    MXU-native training mode; flax keeps parameters f32 under dtype=bf16)."""
+    import jax.numpy as jnp
+
+    from heat_tpu.nn import ResNet18
+
+    return _train_one_model(
+        ResNet18(num_classes=10, dtype=jnp.bfloat16), "resnet18_bf16"
+    )
+
+
 STAGES = {
     "init": stage_init,
     "mosaic_probe": stage_mosaic_probe,
@@ -602,6 +614,7 @@ STAGES = {
     "moments_diag": stage_moments_diag,
     "attention": stage_attention,
     "train50": stage_train50,
+    "train_bf16": stage_train_bf16,
     "attention_sweep": stage_attention_sweep,
     "train": stage_train,
 }

@@ -10,6 +10,13 @@ import ast
 import os
 import sys
 
+# Running as ``python scripts/api_parity_check.py`` puts scripts/ (not the
+# repo root) on sys.path; do NOT touch PYTHONPATH for this — the axon
+# backend registration rides on it.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
 SUBMODULES = (
     "nn", "optim", "cluster", "spatial", "utils", "linalg", "random",
     "datasets", "classification", "naive_bayes", "regression", "graph",

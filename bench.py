@@ -215,29 +215,6 @@ def worker() -> None:
         flush=True,
     )
 
-    # two-point marginal rate for the primary, BEFORE the other configs: a
-    # 10x-iteration program's time spread cancels every fixed per-dispatch
-    # cost (tunnel RTT ~67 ms measured against ~0.9 ms/iter — a 3x spread is
-    # noise-level), yielding the steady-state rate the reference's on-node
-    # protocol sees. Runs this early so a salvaged-on-timeout record still
-    # carries the roofline-bearing marginal fields.
-    lloyd_marginal = lloyd_fixed_ms = None
-    try:
-        _, _, _, shift10 = _primary_run(10 * ITERS)
-        float(shift10)  # compile
-        best10 = float("inf")
-        for _ in range(2):
-            start = time.perf_counter()
-            _, _, _, shift10 = _primary_run(10 * ITERS)
-            float(shift10)
-            best10 = min(best10, time.perf_counter() - start)
-        if best10 > best:
-            marg = (best10 - best) / (9 * ITERS)
-            lloyd_marginal = round(1.0 / marg, 3)
-            lloyd_fixed_ms = round((best - ITERS * marg) * 1e3, 1)
-    except Exception:  # noqa: BLE001 - diagnostics must never cost the record
-        pass
-
     # -- cdist GB/s/chip (config 2) ---------------------------------------
     from heat_tpu.spatial.distance import _euclidian_fast
 
@@ -316,13 +293,36 @@ def worker() -> None:
         "qr_tflops": round(qr_tflops, 3),
         "qr_shape": [qr_m, QR_N],
     }
-    if lloyd_marginal is not None:
-        record["lloyd_iters_per_sec_marginal"] = lloyd_marginal
-        record["lloyd_fixed_ms"] = lloyd_fixed_ms
     annotate_roofline(record)
     # the COMPLETE record is banked before any diagnostics run: a hang below
-    # costs only the two diagnostic fields, never the tracked configs
+    # costs only the diagnostic fields, never the tracked configs
     print(json.dumps(record), flush=True)
+
+    # lloyd two-point marginal FIRST among the diagnostics, with the updated
+    # record re-banked IMMEDIATELY after: a 10x-iteration program's time
+    # spread cancels the per-program fixed cost (tunnel RTT ~67 ms measured
+    # against ~0.9 ms/iter), yielding the steady-state rate the reference's
+    # on-node protocol sees. The 1.2x acceptance floor keeps timing noise
+    # from inflating the marginal unboundedly (a near-zero delta would imply
+    # an arbitrarily high rate); rejected marginals leave the wall rate as
+    # the record's only — honest — number.
+    try:
+        _, _, _, shift10 = _primary_run(10 * ITERS)
+        float(shift10)  # compile
+        best10 = float("inf")
+        for _ in range(2):
+            start = time.perf_counter()
+            _, _, _, shift10 = _primary_run(10 * ITERS)
+            float(shift10)
+            best10 = min(best10, time.perf_counter() - start)
+        if best10 >= 1.2 * best:
+            marg = (best10 - best) / (9 * ITERS)
+            record["lloyd_iters_per_sec_marginal"] = round(1.0 / marg, 3)
+            record["lloyd_fixed_ms"] = round((best - ITERS * marg) * 1e3, 1)
+            annotate_roofline(record)
+            print(json.dumps(record), flush=True)  # last parseable line wins
+    except Exception:  # noqa: BLE001 - diagnostics must never cost the record
+        pass
 
     # dispatch round-trip floor: every measurement above synchronized via one
     # host scalar read, and on the tunneled axon backend that round trip is a
@@ -340,9 +340,6 @@ def worker() -> None:
         record["dispatch_rtt_ms"] = round(rtt * 1e3, 2)
     except Exception:  # noqa: BLE001 - diagnostics must never cost the record
         pass
-
-    # (the lloyd two-point marginal runs BEFORE the record is built — see
-    # above the cdist config — so salvaged records carry it too)
 
     # two-point marginal rates for cdist and moments: K chained evaluations
     # inside ONE program vs 1, cancelling the fixed per-dispatch cost (the

@@ -76,6 +76,17 @@ def _err(exc: BaseException) -> str:
     return (repr(exc)[:600] + " || tb-tail: " + tb[-1200:]) if tb else repr(exc)[:600]
 
 
+def _marginal_sec(best1: float, bestN: float, extra_units: int):
+    """Marginal seconds per unit from a (1x, Nx) two-point pair, or None
+    when the spread is inside timing noise — the ONE acceptance rule for
+    every marginal in this ladder and in bench.py: a near-zero delta would
+    imply an unboundedly inflated rate, so require the Nx run to clearly
+    dominate the fixed cost (>= 1.2x) before subtracting."""
+    if bestN < 1.2 * best1:
+        return None
+    return (bestN - best1) / extra_units
+
+
 # ---------------------------------------------------------------------------
 # stages — each returns a dict merged under its own key
 # ---------------------------------------------------------------------------
@@ -266,8 +277,8 @@ def stage_lloyd_full():
             best10 = _timeit(
                 lambda: fn(data, centers, k, 10 * iters), lambda r: float(r[3]), reps=2
             )
-            if best10 > best:
-                marg = (best10 - best) / (9 * iters)
+            marg = _marginal_sec(best, best10, 9 * iters)
+            if marg:
                 out[f"{name}_iters_per_sec_marginal"] = round(1.0 / marg, 2)
                 out[f"{name}_fixed_ms"] = round((best - iters * marg) * 1e3, 1)
         except Exception as exc:  # noqa: BLE001 - bank the other path regardless
@@ -380,8 +391,8 @@ def stage_cdist():
     # and writes the n^2 result; the chain's carry add fuses into the tile
     ev_bytes = (2.0 * n * f + n * n) * 4
     out["cdist_gbps"] = round(ev_bytes / best1 / 1e9, 2)
-    if best8 > best1:
-        marg = (best8 - best1) / 7
+    marg = _marginal_sec(best1, best8, 7)
+    if marg:
         out["cdist_gbps_marginal"] = round(ev_bytes / marg / 1e9, 2)
         out["cdist_fixed_ms"] = round((best1 - marg) * 1e3, 1)
     return out
@@ -463,8 +474,8 @@ def stage_attention():
         best = _timeit(lambda: one(q, k, v), lambda r: float(r[0, 0, 0, 0]))
         best8 = _timeit(lambda: eight(q, k, v), lambda r: float(r[0, 0, 0, 0]), reps=2)
         out[f"{name}_attn_causal_4k_tflops"] = round(att_flops / best / 1e12, 2)
-        if best8 > best:
-            marg = (best8 - best) / 7
+        marg = _marginal_sec(best, best8, 7)
+        if marg:
             out[f"{name}_attn_causal_4k_tflops_marginal"] = round(
                 att_flops / marg / 1e12, 2
             )
@@ -560,8 +571,9 @@ def stage_attention_sweep():
             one, more = chained(0), chained(7)
             b1 = _timeit(lambda: one(q, k, v), lambda r: float(r[0, 0, 0, 0]), reps=2)
             b8 = _timeit(lambda: more(q, k, v), lambda r: float(r[0, 0, 0, 0]), reps=2)
-            if b8 > b1:
-                rate = att_flops / ((b8 - b1) / 7) / 1e12
+            marg = _marginal_sec(b1, b8, 7)
+            if marg:
+                rate = att_flops / marg / 1e12
                 out[f"bq{bq}_bk{bk}_tflops_marginal"] = round(rate, 2)
                 if rate > best_rate:
                     best_rate, best_cfg = rate, [bq, bk]

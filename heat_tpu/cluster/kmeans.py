@@ -164,22 +164,30 @@ class KMeans(_KCluster):
 
         # iterations run in fused chunks of up to 8 per dispatch; convergence
         # is checked at chunk boundaries (coarser than the reference's
-        # per-iteration check, identical fixed point)
+        # per-iteration check, identical fixed point). The loop-invariant
+        # operands (the samples-in-lanes transpose and Σ|x|²) are computed
+        # ONCE here, not per chunk — they are full-data passes.
         labels = None
         inertia = None
         done = 0
         n_global = int(x.shape[0])
+        xT = xsq = None
         while done < self.max_iter:
             chunk = min(8, self.max_iter - done)
             try:
                 if mode == "single":
+                    if xT is None:
+                        xT, xsq = _lloyd._prepare_run_operands(data, self.n_clusters)
                     centers, labels, inertia, shift = _lloyd.fused_lloyd_run(
-                        data, centers, self.n_clusters, chunk, interpret=interpret
+                        data, centers, self.n_clusters, chunk, interpret=interpret,
+                        xT=xT, xsq_sum=xsq,
                     )
                 elif mode == "sharded":
+                    if xsq is None:
+                        xsq = _lloyd._sharded_xsq(data, n_global=n_global)
                     centers, labels, inertia, shift = _lloyd.fused_lloyd_run_sharded(
                         data, centers, self.n_clusters, x.comm, n_global, chunk,
-                        interpret=interpret,
+                        interpret=interpret, xsq_sum=xsq,
                     )
                 else:
                     centers, labels, inertia, shift = _lloyd_run(

@@ -49,6 +49,7 @@ program, not once per iteration.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -281,18 +282,44 @@ def _finalize(sumsT, counts, inertia, centers, xsq_sum):
     return new_centers, inertia_full, shift
 
 
+def prepare_run_operands(data: jax.Array, k: int):
+    """(xT, xsq_sum) for :func:`fused_lloyd_run` — callers driving MANY run
+    chunks over the same operand (KMeans.fit's convergence loop) compute
+    these ONCE and pass them in, instead of paying the transpose + Σ|x|²
+    data passes on every chunk."""
+    x32 = data.astype(jnp.float32)
+    return (
+        _prepare(data, _block_cols(data.shape[1], k)),
+        jnp.sum(x32 * x32),
+    )
+
+
+_prepare_run_operands = functools.partial(jax.jit, static_argnames="k")(
+    prepare_run_operands
+)
+
+
 @functools.partial(jax.jit, static_argnames=("k", "n_steps", "interpret"))
 def fused_lloyd_run(
-    data: jax.Array, centers: jax.Array, k: int, n_steps: int, interpret: bool = False
+    data: jax.Array,
+    centers: jax.Array,
+    k: int,
+    n_steps: int,
+    interpret: bool = False,
+    xT: Optional[jax.Array] = None,
+    xsq_sum: Optional[jax.Array] = None,
 ):
     """``n_steps`` fused iterations in one XLA program (the pallas analog of
     ``cluster.kmeans._lloyd_run``): Σ|x|² and the samples-in-lanes transpose
-    hoisted, one kernel pass per step, labels from ONE epilogue pass against
-    the last iteration's input centers (the jnp oracle's exact label
-    convention)."""
-    x32 = data.astype(jnp.float32)
-    xsq_sum = jnp.sum(x32 * x32)
-    xT = _prepare(data, _block_cols(data.shape[1], k))
+    hoisted (within the program — pass ``xT``/``xsq_sum`` from
+    :func:`prepare_run_operands` to hoist them across chunked calls too),
+    one kernel pass per step, labels from ONE epilogue pass against the last
+    iteration's input centers (the jnp oracle's exact label convention)."""
+    if xsq_sum is None:
+        x32 = data.astype(jnp.float32)
+        xsq_sum = jnp.sum(x32 * x32)
+    if xT is None:
+        xT = _prepare(data, _block_cols(data.shape[1], k))
     n_valid = jnp.asarray(data.shape[0], jnp.int32)
 
     def body(i, carry):
@@ -373,6 +400,10 @@ def _logical_xsq_sum(data, n_global):
     return jnp.sum(x32 * x32)
 
 
+_sharded_xsq = functools.partial(jax.jit, static_argnames="n_global")(_logical_xsq_sum)
+"""Chunk-loop hoist of the sharded Σ|x|² (KMeans.fit computes it once)."""
+
+
 @functools.lru_cache(maxsize=None)
 def _sharded_fn(mesh, axis, p, k, n_global, interpret):
     """Jitted sharded iteration, cached per static config (the
@@ -399,16 +430,18 @@ def fused_lloyd_run_sharded(
     n_global: int,
     n_steps: int,
     interpret: bool = False,
+    xsq_sum: Optional[jax.Array] = None,
 ):
     """``n_steps`` fused sharded iterations in ONE XLA program — the
-    multi-chip analog of :func:`fused_lloyd_run`: Σ|x|² hoisted once, the
-    fori_loop of single-pass kernel steps INSIDE the shard_map (so each
-    device's samples-in-lanes transpose is paid once per program), one psum
+    multi-chip analog of :func:`fused_lloyd_run`: Σ|x|² hoisted once (pass
+    ``xsq_sum`` to hoist it across chunked calls too; the per-device
+    transpose lives inside the shard_map and is paid once per program), the
+    fori_loop of single-pass kernel steps INSIDE the shard_map, one psum
     per step."""
     fn = _sharded_run_fn(
         comm.mesh, comm.axis_name, comm.size, k, int(n_global), int(n_steps), bool(interpret)
     )
-    return fn(data, centers)
+    return fn(data, centers, xsq_sum)
 
 
 @functools.lru_cache(maxsize=None)
@@ -436,8 +469,9 @@ def _sharded_run_fn(mesh, axis, p, k, n_global, n_steps, interpret):
         return jax.lax.fori_loop(0, n_steps, body, (c0, c0, acc, acc))
 
     @jax.jit
-    def run(data, centers):
-        xsq_sum = _logical_xsq_sum(data, n_global)
+    def run(data, centers, xsq_sum=None):
+        if xsq_sum is None:
+            xsq_sum = _logical_xsq_sum(data, n_global)
         new_c, used, inertia, shift = jax.shard_map(
             device_run,
             mesh=mesh,

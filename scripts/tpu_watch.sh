@@ -23,8 +23,30 @@ PY
     then
       echo "=== stages banked, running fresh bench ===" >> /tmp/tpu_watch.log
       timeout 2700 python bench.py >> /tmp/tpu_watch_bench.log 2>&1
-      echo DONE >> /tmp/tpu_watch.log
-      break
+      # DONE only when the bench actually produced a TPU record — a
+      # mid-bench tunnel drop must leave the loop retrying, not exit
+      if python - <<'PY'
+import json, sys
+rec = None
+try:
+    for line in open("/tmp/tpu_watch_bench.log"):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                cand = json.loads(line)
+            except Exception:
+                continue
+            if isinstance(cand, dict) and cand.get("platform") == "tpu":
+                rec = cand
+except FileNotFoundError:
+    pass
+sys.exit(0 if rec else 1)
+PY
+      then
+        echo DONE >> /tmp/tpu_watch.log
+        break
+      fi
+      echo "=== bench produced no TPU record; retrying ===" >> /tmp/tpu_watch.log
     fi
   fi
   sleep 280

@@ -130,11 +130,24 @@ class TestFusedLloyd(TestCase):
         assert np.isfinite(np.asarray(sumsT)).all()
         assert np.isfinite(float(inertia[0, 0]))
 
+        # the accumulator VALUES must equal the clean oracle's — finiteness
+        # alone would admit a finite-but-garbage pad score leaking through
         ref_c, ref_lab, ref_inertia, _ = jax.jit(_lloyd_iter, static_argnames="k")(
             jnp.asarray(data_np), centers, k
         )
         got_counts = np.asarray(counts)[:, 0]
         assert got_counts.sum() == n  # no pad sample counted
+        onehot = np.eye(k, dtype=np.float32)[np.asarray(ref_lab)]
+        np.testing.assert_array_equal(got_counts, onehot.sum(axis=0))
+        np.testing.assert_allclose(
+            np.asarray(sumsT), (onehot.T @ data_np).T, rtol=1e-5, atol=1e-4
+        )
+        # kernel inertia omits the Σ|x|² term the full contract restores
+        np.testing.assert_allclose(
+            float(inertia[0, 0]) + float(np.sum(data_np.astype(np.float64) ** 2)),
+            float(ref_inertia),
+            rtol=1e-4,
+        )
 
     def test_bf16_stream_matches_f32_oracle_loosely(self):
         # bf16 operands stream as bf16 (half the HBM bytes); accumulators

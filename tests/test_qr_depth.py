@@ -216,6 +216,17 @@ class TestCholQR2(TestCase):
         with pytest.raises(ValueError, match="cholqr2 broke down"):
             ht.linalg.qr(ht.array(a_np, split=0), method="cholqr2")
 
+    def test_auto_is_the_default_method(self):
+        # the default flipped to "auto" on the measured 6.7x v5e margin
+        # (benchmarks/TPU_WINDOW_r04.json cholqr2 stage): a bare qr() on a
+        # well-conditioned tall-skinny operand must take the cholqr2 path
+        rng = np.random.default_rng(23)
+        a_np = rng.standard_normal((64, 4)).astype(np.float32)
+        q, r = ht.linalg.qr(ht.array(a_np, split=0))
+        assert (np.diag(np.asarray(r.larray)) > 0).all()  # cholqr2 signature
+        q_np = np.asarray(q.larray)
+        np.testing.assert_allclose(q_np.T @ q_np, np.eye(4), atol=2e-4)
+
     def test_auto_uses_cholqr2_when_well_conditioned(self):
         rng = np.random.default_rng(22)
         a_np = rng.standard_normal((48, 4)).astype(np.float32)

@@ -42,6 +42,14 @@ def main() -> None:
     t0 = time.perf_counter()
     for i in range(60):
         w, loss = step(w)  # loss is evaluated at the PRE-update iterate
+        # bound in-flight programs to ONE: each step's program contains a
+        # cross-partition all-reduce, and on a forced-host-device CPU mesh
+        # with few cores, many queued collective programs can starve XLA's
+        # spin-wait rendezvous past its hard 40 s abort (observed at mesh 5
+        # on a 1-core sandbox). The "whole step is one compiled program"
+        # point is unaffected; real accelerator backends pipeline fine, but
+        # the example must be robust where the test matrix runs it.
+        float(loss)
     elapsed = time.perf_counter() - t0
 
     err = float(np.abs(w.numpy() - w_true).max())

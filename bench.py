@@ -60,7 +60,11 @@ def _roofline_peaks(platform: str):
         cap_path = os.path.join(BANK_DIR, "TPU_CAPABILITY.json")
         with open(cap_path) as fh:
             cap = json.load(fh)
-        measured = cap.get("hbm_read_gbps_rtt_corrected") or cap.get("hbm_read_gbps")
+        measured = (
+            cap.get("hbm_read_gbps_marginal")
+            or cap.get("hbm_read_gbps_rtt_corrected")
+            or cap.get("hbm_read_gbps")
+        )
         if measured and measured > peaks["hbm_gbps"]:
             peaks["hbm_gbps"] = float(measured)
             peaks["chip"] += f" + measured triad {measured} GB/s"

@@ -314,6 +314,26 @@ def stage_capability():
         out[f"matmul_{name}_{n}_tflops"] = round(flops / best / 1e12, 2)
         out[f"matmul_{name}_{n}_tflops_rtt_corrected"] = round(flops / corrected(best) / 1e12, 2)
 
+        # chained marginal: one 4k matmul is ~2.6 ms against the ~67 ms
+        # tunnel RTT, so the subtraction above is noise — chain 16 dependent
+        # matmuls in ONE program and difference against 1
+        def chain(reps, mm_a=a, mm_b=b):
+            @jax.jit
+            def run(x, y):
+                def body(i, acc):
+                    return (acc @ y).astype(x.dtype)
+
+                return jax.lax.fori_loop(0, reps, body, x)[0, 0].astype(jnp.float32)
+
+            return run
+
+        c1, c16 = chain(1), chain(16)
+        b1 = _timeit(lambda: c1(a, b), lambda r: float(r), reps=2)
+        b16 = _timeit(lambda: c16(a, b), lambda r: float(r), reps=2)
+        marg = _marginal_sec(b1, b16, 15)
+        if marg:
+            out[f"matmul_{name}_{n}_tflops_marginal"] = round(flops / marg / 1e12, 2)
+
     n = 64 * 1024 * 1024
     x = jax.random.normal(jax.random.PRNGKey(2), (n,), jnp.float32)
     y = jax.random.normal(jax.random.PRNGKey(3), (n,), jnp.float32)
@@ -321,6 +341,26 @@ def stage_capability():
     best = _timeit(lambda: triad(x, y), lambda r: float(r))
     out["hbm_read_gbps"] = round(2 * n * 4 / best / 1e9, 1)
     out["hbm_read_gbps_rtt_corrected"] = round(2 * n * 4 / corrected(best) / 1e9, 1)
+
+    # chained triad marginal: each step reads both operands and feeds a
+    # scalar back, so nothing hoists; 8-vs-1 differencing cancels the RTT
+    def tchain(reps):
+        @jax.jit
+        def run(a, b):
+            def body(i, carry):
+                s = (a * 1.5 + b + carry).sum()
+                return s * 1e-30
+
+            return jax.lax.fori_loop(0, reps, body, jnp.zeros((), jnp.float32))
+
+        return run
+
+    t1, t8 = tchain(1), tchain(8)
+    b1 = _timeit(lambda: t1(x, y), lambda r: float(r), reps=2)
+    b8 = _timeit(lambda: t8(x, y), lambda r: float(r), reps=2)
+    marg = _marginal_sec(b1, b8, 7)
+    if marg:
+        out["hbm_read_gbps_marginal"] = round(2 * n * 4 / marg / 1e9, 1)
     out["dispatch_rtt_ms"] = round(rtt * 1e3, 2)
     return out
 

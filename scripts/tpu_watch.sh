@@ -8,7 +8,8 @@ for i in $(seq 1 "${TPU_WATCH_TRIES:-40}"); do
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "=== tunnel up, attempt $i $(date -u +%H:%M:%S) ===" >> /tmp/tpu_watch.log
     timeout 1800 python benchmarks/tpu_window.py \
-      --out benchmarks/TPU_WINDOW_r04.json --stages attention,cdist,train50,train_bf16,attention_sweep \
+      --out benchmarks/TPU_WINDOW_r04.json --force \
+      --stages attention,cdist,train50,train_bf16,attention_sweep,capability \
       >> /tmp/tpu_watch.log 2>&1
     if python - <<'PY'
 import json, sys

@@ -292,6 +292,36 @@ def stage_lloyd_full():
     return out
 
 
+def stage_lloyd_bf16():
+    """Fused Lloyd on a bfloat16 stream at the bench shape — the operand
+    stays bf16 on the MXU (half the HBM bytes of the f32 stream), so a
+    bandwidth-bound marginal should land near 2x lloyd_full's f32 rate."""
+    import jax
+    import jax.numpy as jnp
+
+    from heat_tpu.ops.lloyd import fused_lloyd_run
+
+    n, f, k, iters = 10_000_000, 16, 8, 10
+    data = jax.random.normal(jax.random.PRNGKey(1), (n, f), dtype=jnp.float32).astype(
+        jnp.bfloat16
+    )
+    centers = jax.random.normal(jax.random.PRNGKey(2), (k, f), dtype=jnp.float32) * 3
+    best = _timeit(
+        lambda: fused_lloyd_run(data, centers, k, iters), lambda r: float(r[3]), reps=3
+    )
+    out = {"n": n, "dtype": "bfloat16", "fused_iters_per_sec": round(iters / best, 2)}
+    best10 = _timeit(
+        lambda: fused_lloyd_run(data, centers, k, 10 * iters),
+        lambda r: float(r[3]),
+        reps=2,
+    )
+    marg = _marginal_sec(best, best10, 9 * iters)
+    if marg:
+        out["fused_iters_per_sec_marginal"] = round(1.0 / marg, 2)
+        out["hbm_gbps_effective"] = round(n * f * 2 / marg / 1e9, 1)
+    return out
+
+
 def stage_capability():
     import jax
     import jax.numpy as jnp
@@ -660,6 +690,7 @@ STAGES = {
     "mosaic_variants": stage_mosaic_variants,
     "lloyd_small": stage_lloyd_small,
     "lloyd_full": stage_lloyd_full,
+    "lloyd_bf16": stage_lloyd_bf16,
     "capability": stage_capability,
     "cholqr2": stage_cholqr2,
     "cdist": stage_cdist,

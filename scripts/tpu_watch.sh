@@ -9,7 +9,7 @@ for i in $(seq 1 "${TPU_WATCH_TRIES:-40}"); do
     echo "=== tunnel up, attempt $i $(date -u +%H:%M:%S) ===" >> /tmp/tpu_watch.log
     timeout 1800 python benchmarks/tpu_window.py \
       --out benchmarks/TPU_WINDOW_r04.json --force \
-      --stages attention,cdist,train50,train_bf16,attention_sweep,capability \
+      --stages attention,cdist,train50,train_bf16,attention_sweep,capability,lloyd_bf16 \
       >> /tmp/tpu_watch.log 2>&1
     if python - <<'PY'
 import json, sys

@@ -111,6 +111,17 @@ def annotate_roofline(rec: dict) -> None:
     rec["roofline_peaks"] = peaks
 
 
+def _marginal_sec(best1: float, bestN: float, extra_units: int):
+    """Marginal seconds per unit from a (1x, Nx) two-point pair, or None
+    when the spread is inside timing noise — the ONE acceptance rule for
+    every marginal here and in benchmarks/tpu_window.py (same name, same
+    1.2x floor: a near-zero delta would imply an unboundedly inflated
+    rate, so the Nx run must clearly dominate the fixed cost first)."""
+    if bestN < 1.2 * best1:
+        return None
+    return (bestN - best1) / extra_units
+
+
 def _metric_name(n: int) -> str:
     if n == N_FULL:
         return "kmeans_iters_per_sec_10Mx16_k8"
@@ -319,8 +330,8 @@ def worker() -> None:
             _, _, _, shift10 = _primary_run(10 * ITERS)
             float(shift10)
             best10 = min(best10, time.perf_counter() - start)
-        if best10 >= 1.2 * best:
-            marg = (best10 - best) / (9 * ITERS)
+        marg = _marginal_sec(best, best10, 9 * ITERS)
+        if marg:
             record["lloyd_iters_per_sec_marginal"] = round(1.0 / marg, 3)
             record["lloyd_fixed_ms"] = round((best - ITERS * marg) * 1e3, 1)
             annotate_roofline(record)
@@ -366,8 +377,8 @@ def worker() -> None:
             start = time.perf_counter()
             float(runk())
             bk = min(bk, time.perf_counter() - start)
-        # only meaningful when the k-step run clearly dominates the fixed cost
-        return (bk - b1) / (steps - 1) if bk >= 1.5 * b1 else None
+        # the shared acceptance rule (same floor as every other marginal)
+        return _marginal_sec(b1, bk, steps - 1)
 
     try:
         def _cdist_chain(steps):

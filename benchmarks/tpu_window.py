@@ -310,15 +310,18 @@ def stage_lloyd_bf16():
         lambda: fused_lloyd_run(data, centers, k, iters), lambda r: float(r[3]), reps=3
     )
     out = {"n": n, "dtype": "bfloat16", "fused_iters_per_sec": round(iters / best, 2)}
-    best10 = _timeit(
-        lambda: fused_lloyd_run(data, centers, k, 10 * iters),
-        lambda r: float(r[3]),
-        reps=2,
-    )
-    marg = _marginal_sec(best, best10, 9 * iters)
-    if marg:
-        out["fused_iters_per_sec_marginal"] = round(1.0 / marg, 2)
-        out["hbm_gbps_effective"] = round(n * f * 2 / marg / 1e9, 1)
+    try:  # bank the wall rate regardless — a marginal-run hiccup must not
+        best10 = _timeit(  # discard the measurement above (lloyd_full's rule)
+            lambda: fused_lloyd_run(data, centers, k, 10 * iters),
+            lambda r: float(r[3]),
+            reps=2,
+        )
+        marg = _marginal_sec(best, best10, 9 * iters)
+        if marg:
+            out["fused_iters_per_sec_marginal"] = round(1.0 / marg, 2)
+            out["hbm_gbps_effective"] = round(n * f * 2 / marg / 1e9, 1)
+    except Exception as exc:  # noqa: BLE001
+        out["marginal_error"] = _err(exc)
     return out
 
 
@@ -347,7 +350,7 @@ def stage_capability():
         # chained marginal: one 4k matmul is ~2.6 ms against the ~67 ms
         # tunnel RTT, so the subtraction above is noise — chain 16 dependent
         # matmuls in ONE program and difference against 1
-        def chain(reps, mm_a=a, mm_b=b):
+        def chain(reps):
             @jax.jit
             def run(x, y):
                 def body(i, acc):
@@ -372,13 +375,17 @@ def stage_capability():
     out["hbm_read_gbps"] = round(2 * n * 4 / best / 1e9, 1)
     out["hbm_read_gbps_rtt_corrected"] = round(2 * n * 4 / corrected(best) / 1e9, 1)
 
-    # chained triad marginal: each step reads both operands and feeds a
-    # scalar back, so nothing hoists; 8-vs-1 differencing cancels the RTT
+    # chained triad marginal: the carry joins the operand BEFORE the triad
+    # arithmetic ((a + carry) * 1.5 + b) so the whole body depends on loop
+    # state — a left-associated `a * 1.5 + b + carry` would let XLA hoist
+    # the invariant a*1.5+b and halve the real traffic while 2 reads/step
+    # are billed, inflating the marginal (the exact rate _roofline_peaks
+    # trusts to raise the assumed HBM peak)
     def tchain(reps):
         @jax.jit
         def run(a, b):
             def body(i, carry):
-                s = (a * 1.5 + b + carry).sum()
+                s = ((a + carry) * 1.5 + b).sum()
                 return s * 1e-30
 
             return jax.lax.fori_loop(0, reps, body, jnp.zeros((), jnp.float32))

@@ -23,21 +23,32 @@ sys.exit(
 PY
     then
       echo "=== stages banked, running fresh bench ===" >> /tmp/tpu_watch.log
-      timeout 2700 python bench.py >> /tmp/tpu_watch_bench.log 2>&1
-      # DONE only when the bench actually produced a TPU record — a
-      # mid-bench tunnel drop must leave the loop retrying, not exit
-      if python - <<'PY'
-import json, sys
+      # per-attempt log: the shared append-log would let an OLD attempt's
+      # record satisfy the gate for a new, failed one
+      BLOG="/tmp/tpu_watch_bench_$i.log"
+      timeout 2700 python bench.py > "$BLOG" 2>&1
+      # DONE only when the bench actually produced a FRESH live-TPU record —
+      # bench's ladder reprints the committed (stale) capture over a CPU
+      # fallback, and that reprint must NOT satisfy this gate
+      if BLOG="$BLOG" python - <<'PY'
+import json, os, sys
 rec = None
 try:
-    for line in open("/tmp/tpu_watch_bench.log"):
+    for line in open(os.environ["BLOG"]):
         line = line.strip()
         if line.startswith("{"):
             try:
                 cand = json.loads(line)
             except Exception:
                 continue
-            if isinstance(cand, dict) and cand.get("platform") == "tpu":
+            if (
+                isinstance(cand, dict)
+                and cand.get("platform") == "tpu"
+                and not any(
+                    k in cand
+                    for k in ("staleness", "reprinted_over_cpu_fallback", "provisional")
+                )
+            ):
                 rec = cand
 except FileNotFoundError:
     pass

@@ -22,6 +22,7 @@ BENCHMARKS = {
     "kmeans": lambda per_dev, p: ["--n", str(per_dev * p), "--iterations", "10", "--trials", "2"],
     "distance_matrix": lambda per_dev, p: ["--n", str(per_dev * p), "--trials", "2"],
     "statistical_moments": lambda per_dev, p: ["--rows", str(per_dev * p), "--trials", "3"],
+    "lasso": lambda per_dev, p: ["--n", str(per_dev * p), "--iterations", "10", "--trials", "2"],
 }
 
 

@@ -21,15 +21,22 @@ Stages (each independently try/except'd):
                 lane argmin, narrow dot, grid accumulator, SMEM scalar) —
                 maps a message-hiding remote-compile 500 to the construct
   lloyd_small   fused_lloyd_run on 64k rows: full error text if it fails
-  lloyd_full    fused vs jnp Lloyd at the bench shape (10M x 16, k=8)
-  capability    MXU matmul bf16/f32 TFLOP/s + HBM triad GB/s (the roofline
-                refinement triad bench.py reads from TPU_CAPABILITY.json)
+  lloyd_full    fused vs jnp Lloyd at the bench shape (10M x 16, k=8),
+                wall + 10x-spread marginal + fixed_ms
+  lloyd_bf16    fused Lloyd on a bfloat16 stream (half the HBM bytes)
+  capability    MXU matmul bf16/f32 TFLOP/s + HBM triad GB/s, single-shot
+                AND chained-marginal forms (the roofline refinement triad
+                bench.py reads from TPU_CAPABILITY.json)
   cholqr2       CholeskyQR2 vs TSQR at the qr bench shape (VERDICT ask 6)
+  cdist         chained-eval marginal GB/s for the cdist tile
   moments_diag  eager ht.mean+ht.std vs the same fused in one jit program —
                 attributes the eager number's RTT share
-  attention     pallas flash attention vs dense at 4k causal
+  attention     pallas flash attention vs dense at 4k causal (marginals)
+  attention_sweep  (block_q, block_k) tile-schedule search, marginal rates
   train         DP ResNet18 samples/s + compiled-step breakdown (the
                 BASELINE config-5 TPU leg; the DASO sweep needs a mesh)
+  train50       DP ResNet-50 (BASELINE config 5's named model)
+  train_bf16    DP ResNet18 with bf16 compute (MXU-native mixed precision)
 
 Usage: python benchmarks/tpu_window.py [--out benchmarks/TPU_WINDOW_r04.json]
        [--stages init,mosaic_probe,...] [--skip-full]

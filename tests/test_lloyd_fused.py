@@ -173,6 +173,33 @@ class TestFusedLloyd(TestCase):
         # labels come from the f32 epilogue: near-exact (ties aside)
         assert (np.asarray(got[1]) == np.asarray(ref[1])).mean() > 0.97
 
+    def test_bf16_sharded_ragged_matches_oracle(self):
+        # the harshest combination: bfloat16 stream x physical pad (ragged
+        # rows) x shard_map psum — accumulators must stay f32-exact w.r.t.
+        # masking while the streamed operand is half-precision
+        import jax
+        import jax.numpy as jnp
+
+        import heat_tpu as ht
+        from heat_tpu.cluster.kmeans import _lloyd_iter
+        from heat_tpu.ops.lloyd import fused_lloyd_iter_sharded
+
+        comm = ht.get_comm()
+        rng = np.random.default_rng(13)
+        n, f, k = 6 * comm.size + 1, 5, 3  # ragged
+        data_np = rng.standard_normal((n, f)).astype(np.float32)
+        centers = jnp.asarray(rng.standard_normal((k, f)).astype(np.float32))
+        x = ht.array(data_np, split=0).astype(ht.bfloat16)
+        got = fused_lloyd_iter_sharded(
+            x.parray, centers, k, comm, n_global=n, interpret=True
+        )
+        ref = jax.jit(_lloyd_iter, static_argnames="k")(jnp.asarray(data_np), centers, k)
+        np.testing.assert_allclose(
+            np.asarray(got[0], np.float32), np.asarray(ref[0]), rtol=0.05, atol=0.05
+        )
+        np.testing.assert_allclose(float(got[2]), float(ref[2]), rtol=0.05)
+        assert got[1].shape[0] == n
+
     def test_kmeans_fit_keeps_bf16_stream(self):
         import jax.numpy as jnp
 

@@ -1,4 +1,8 @@
-"""Lasso benchmark (reference: benchmarks/lasso/config.json protocol)."""
+"""Lasso benchmark (reference: benchmarks/lasso/config.json protocol).
+
+``--torch-baseline`` also runs the same coordinate-descent sweep in torch on
+CPU (the reference's comparison baseline, benchmarks/lasso/torch-cpu.py) and
+reports ``torch_time_s`` + ``vs_torch``."""
 
 import os
 import sys
@@ -16,6 +20,7 @@ def main():
     parser.add_argument("--f", type=int, default=64)
     parser.add_argument("--iterations", type=int, default=20)
     parser.add_argument("--trials", type=int, default=3)
+    parser.add_argument("--torch-baseline", action="store_true")
     args = parser.parse_args()
 
     import os
@@ -38,17 +43,52 @@ def main():
         lasso.fit(x, y)
         float(lasso.theta.larray[0, 0])
         times.append(time.perf_counter() - start)
-    print(
-        json.dumps(
-            {
-                "benchmark": "lasso",
-                "n": args.n,
-                "f": args.f,
-                "devices": ht.get_comm().size,
-                "time_s": round(min(times), 4),
-            }
-        )
-    )
+
+    rec = {
+        "benchmark": "lasso",
+        "n": args.n,
+        "f": args.f,
+        "devices": ht.get_comm().size,
+        "time_s": round(min(times), 4),
+    }
+    if args.torch_baseline:
+        t = _torch_lasso_sec(args.n, args.f, args.iterations, args.trials)
+        rec["torch_time_s"] = round(t, 4)
+        rec["vs_torch"] = round(t / rec["time_s"], 2)
+    print(json.dumps(rec))
+
+
+def _torch_lasso_sec(n: int, f: int, iters: int, trials: int) -> float:
+    """The same coordinate-descent sweep in torch on CPU — the reference's
+    single-node comparison baseline (benchmarks/lasso/torch-cpu.py): per
+    feature, rho from the current residual, soft threshold, intercept free."""
+    import torch
+
+    torch.manual_seed(0)
+    X = torch.randn(n, f)
+    y = torch.randn(n, 1)
+    lam = 0.1
+
+    def fit():
+        theta = torch.zeros(f, 1)
+        for _ in range(iters):
+            for j in range(f):
+                X_j = X[:, j]
+                y_est = X @ theta
+                rho = (X_j @ (y.ravel() - y_est.ravel() + theta[j, 0] * X_j)) / n
+                if j == 0:
+                    theta[j, 0] = rho
+                else:
+                    theta[j, 0] = torch.sign(rho) * torch.clamp(rho.abs() - lam, min=0.0)
+        return theta
+
+    fit()  # warmup
+    best = float("inf")
+    for _ in range(trials):
+        start = time.perf_counter()
+        fit()
+        best = min(best, time.perf_counter() - start)
+    return best
 
 
 if __name__ == "__main__":

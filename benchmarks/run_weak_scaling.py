@@ -40,9 +40,15 @@ def main():
         env = dict(os.environ)
         env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={p}"
         env["HEAT_TPU_FORCE_CPU"] = "1"
+        extra = []
+        if args.benchmark == "lasso" and p == 1:
+            # single-node external baseline (reference benchmarks/lasso/
+            # torch-cpu.py): one torch-CPU run at the 1-device size
+            extra = ["--torch-baseline"]
         out = subprocess.run(
             [sys.executable, f"benchmarks/{args.benchmark}.py"]
-            + BENCHMARKS[args.benchmark](args.per_device, p),
+            + BENCHMARKS[args.benchmark](args.per_device, p)
+            + extra,
             capture_output=True,
             text=True,
             env=env,

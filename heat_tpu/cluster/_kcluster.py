@@ -171,10 +171,15 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         return self._wrap_labels(labels, x)
 
     def _wrap_labels(self, labels: jax.Array, x: DNDarray) -> DNDarray:
+        # labels are 1-D over SAMPLES: they inherit x's split only when x is
+        # sample-split (split=0); a feature-split input (split=1) has every
+        # device owning all samples, so its labels are replicated (the
+        # reference's split-semantics for 1-D results of a split=1 operand)
+        split = 0 if x.split == 0 else None
         labels = labels.astype(types.index_dtype())
-        labels = _ensure_split(labels, x.split, x.comm)
+        labels = _ensure_split(labels, split, x.comm)
         return DNDarray(
-            labels, tuple(labels.shape), types.canonical_heat_type(labels.dtype), x.split, x.device, x.comm
+            labels, tuple(labels.shape), types.canonical_heat_type(labels.dtype), split, x.device, x.comm
         )
 
     def predict(self, x: DNDarray) -> DNDarray:

@@ -18,7 +18,7 @@ The TPU rendering keeps the per-chunk protocol:
 * **netCDF**: netCDF4 files *are* HDF5 files; load/save are implemented over
   ``h5py`` with netCDF dimension-scale conventions (reference io.py:246-660
   uses the netCDF4 library; this environment ships h5py only). Classic
-  NETCDF3 (CDF magic) is detected and rejected with a clear error.
+  NETCDF3 (CDF magic) is detected and read via scipy.io.netcdf_file's mmap.
 """
 
 from __future__ import annotations
@@ -261,28 +261,65 @@ def _write_h5_dataset(handle, dataset: str, data: DNDarray, **kwargs):
 # datasets carrying dimension scales, which h5py manipulates natively — so
 # load/save speak the netCDF4 enhanced-model conventions directly and reuse
 # the sharded HDF5 machinery above. Classic NETCDF3 files (magic b"CDF") are
-# a different on-disk format and rejected explicitly.
+# a different on-disk format, read through scipy.io.netcdf_file (write stays
+# netCDF4 — the reference's own default output format).
 # ----------------------------------------------------------------------------
-def _reject_netcdf3(path: str) -> None:
+def _is_netcdf3(path: str) -> bool:
     with open(path, "rb") as f:
-        magic = f.read(3)
-    if magic == b"CDF":
+        return f.read(3) == b"CDF"
+
+
+def _load_netcdf3(path, variable, dtype, split, device, comm) -> DNDarray:
+    """Classic NETCDF3 read via ``scipy.io.netcdf_file`` (dependency-free —
+    the reference reaches this format through the netCDF4 library, absent
+    here). The file is memory-mapped, so each device's block is a lazy
+    per-range read through the same per-shard ingest as HDF5."""
+    try:
+        import scipy.io as _sio
+    except ImportError as exc:  # pragma: no cover - scipy present in CI
         raise RuntimeError(
-            "classic NETCDF3 format is not supported (requires the netCDF4 "
-            "library); re-save the file as NETCDF4 (HDF5-based)"
+            "classic NETCDF3 files require scipy (pip install 'heat-tpu[io]' "
+            "or scipy>=1.8); netCDF4 (HDF5-based) files need only h5py"
+        ) from exc
+
+    comm = sanitize_comm(comm)
+    device = devices_module.sanitize_device(device)
+    nc = _sio.netcdf_file(path, "r", mmap=True)
+    var = None
+    try:
+        if variable not in nc.variables:
+            raise KeyError(f"variable {variable!r} not in {sorted(nc.variables)}")
+        var = nc.variables[variable]
+        gshape = tuple(int(s) for s in var.shape)
+        if split is None or len(gshape) == 0:
+            arr = np.array(var[...] if gshape else var.getValue())
+            return factories.array(arr, dtype=dtype, split=None, device=device, comm=comm)
+        split = split % len(gshape)
+        # copy each block out of the mmap before the file closes
+        return _sharded_ingest(
+            lambda sl: np.array(var[sl]), gshape, dtype, split, device, comm
         )
+    finally:
+        # every np.array() above copied; drop the mmap-backed variable ref so
+        # close() does not warn about live views into the unmapped file
+        del var
+        nc.close()
 
 
 def load_netcdf(
     path: str, variable: str, dtype=types.float32, split: Optional[int] = None, device=None, comm=None
 ) -> DNDarray:
-    """Load a netCDF4 variable (reference io.py:246-414: every rank slices
-    its own chunk). Same per-device hyperslab protocol as :func:`load_hdf5`."""
+    """Load a netCDF variable (reference io.py:246-414: every rank slices
+    its own chunk). netCDF4 (HDF5-based) files use the same per-device
+    hyperslab protocol as :func:`load_hdf5`; classic NETCDF3 files (magic
+    ``CDF``) are read through ``scipy.io.netcdf_file``'s mmap — both formats
+    the reference covers via the netCDF4 library."""
     if not isinstance(path, str):
         raise TypeError(f"path must be str, but was {type(path)}")
     if not isinstance(variable, str):
         raise TypeError(f"variable must be str, but was {type(variable)}")
-    _reject_netcdf3(path)
+    if _is_netcdf3(path):
+        return _load_netcdf3(path, variable, dtype, split, device, comm)
     return load_hdf5(path, variable, dtype=dtype, split=split, device=device, comm=comm)
 
 

@@ -65,14 +65,19 @@ def qr(
     for re-orthonormalization. Every FLOP is a matmul, so on TPU it runs on
     the MXU where Householder QR is mostly vector work; the price is a
     squared condition number in the first pass — safe for
-    ``cond(A) ≲ 1/√ε`` (~3e3 f32 / ~7e7 f64), and it raises on detected
-    breakdown (non-finite Cholesky) rather than returning garbage.
+    ``cond(A) ≲ 1/√ε`` (~3e3 f32 / ~7e7 f64). Breakdown detection is a
+    single fused on-device probe (one host read): non-finite Cholesky OR
+    first-pass orthogonality error ``‖Q1ᴴQ1 − I‖ >= 0.5`` — the latter
+    catches operands near the ``1/√ε`` bound whose Gram Cholesky stays
+    finite while Q silently degrades below Householder quality (the second
+    pass only restores orthogonality while that error is < 1).
+    ``method="cholqr2"`` raises on the probe rather than returning garbage.
     ``"auto"`` tries the MXU-native CholeskyQR2 first for genuinely
     tall-skinny operands (``m >= 2n``, Gram small enough to replicate,
     split != 1 — the panel path's split-1 R layout must not depend on
-    conditioning) and falls back to TSQR on the same breakdown probe
-    instead of raising — the all-matmul speed when conditioning allows,
-    Householder stability when it does not. ``"auto"`` became the default
+    conditioning) and falls back to TSQR on the same probe instead of
+    raising — the all-matmul speed when conditioning allows, Householder
+    stability when it does not. ``"auto"`` became the default
     once a real-TPU capture showed the margin at the benchmark shape:
     CholeskyQR2 1.29 TFLOP/s vs TSQR 0.19 — 6.7x
     (benchmarks/TPU_WINDOW_r04.json, cholqr2 stage, v5e 2M x 256 f32).
@@ -108,18 +113,20 @@ def qr(
         and a.split != 1
     ):
         # try the MXU-native CholeskyQR2, fall back to Householder on the
-        # breakdown probe (ill-conditioned squared-condition first pass)
-        q_try, r_try = _cholqr2_kernel(a.larray, calc_q)
-        if bool(jnp.isfinite(r_try).all()):
+        # breakdown/conditioning probe (one host scalar read; the probe also
+        # catches finite-but-degraded orthogonality, see _cholqr2_kernel)
+        q_try, r_try, ok = _cholqr2_kernel(a.larray, calc_q)
+        if bool(ok):
             q_arr, r_arr = q_try, r_try
     elif method == "cholqr2":
         if m < n:
             raise ValueError(f"cholqr2 requires a tall operand (m >= n), got {a.shape}")
-        q_arr, r_arr = _cholqr2_kernel(a.larray, calc_q)
-        if not bool(jnp.isfinite(r_arr).all()):
+        q_arr, r_arr, ok = _cholqr2_kernel(a.larray, calc_q)
+        if not bool(ok):
             raise ValueError(
-                "cholqr2 broke down (Cholesky of the Gram matrix is not finite): "
-                "the operand is rank-deficient or too ill-conditioned for the "
+                "cholqr2 broke down (non-finite Cholesky of the Gram matrix, or "
+                "first-pass orthogonality error ‖Q1ᴴQ1 − I‖ >= 0.5): the operand "
+                "is rank-deficient or too ill-conditioned (cond ≳ 1/√ε) for the "
                 "squared-condition first pass — use method='tsqr'"
             )
 
@@ -300,22 +307,52 @@ def _panel_qr_split1(a: DNDarray, comm) -> Tuple[jax.Array, jax.Array]:
 
 @functools.partial(jax.jit, static_argnames=("calc_q",))
 def _cholqr2_kernel(x, calc_q: bool = True):
-    """Two CholeskyQR passes, one XLA program. Everything is a matmul or a
-    small (n, n) factorization, so the m-dimensional work runs on the MXU and
-    GSPMD turns the Gram contractions into psums over the split axis.
+    """Two CholeskyQR passes, one XLA program, returning ``(q, r, ok)``.
+
+    Everything tall is a matmul: the Gram contractions run on the MXU (and
+    GSPMD turns them into psums over the split axis), and Q formation is
+    ``x @ R⁻¹`` — R⁻¹ computed once per pass by an (n, n) triangular solve
+    against the identity — instead of an (m, n) triangular solve. XLA lowers
+    a big ``triangular_solve`` on TPU to a blocked substitution sweep that
+    runs at a fraction of matmul rate; inverting the SMALL factor and
+    substituting a GEMM keeps the m-dimensional work entirely on the MXU
+    (the r04 capture measured the solve formulation at ~1% of the chip's
+    matmul capability; see benchmarks/tpu_window.py stage_qr_marginal).
+    Numerically the inverse of the small triangular factor is applied to the
+    same operand the solve would see, and CholeskyQR2's second pass restores
+    first-pass orthogonality loss either way.
+
+    ``ok`` is the breakdown/conditioning probe, computed on-device so the
+    caller pays ONE host scalar read: finite R AND ``max|Q1ᴴQ1 − I| < 0.5``.
+    The second-pass Gram is exactly the first pass's orthogonality error, so
+    this rejects not just NaN breakdown (rank deficiency) but the gradual
+    degradation where a near-``1/√ε``-conditioned operand keeps the Cholesky
+    finite while Q drifts from orthonormal (advisor finding r04#3): CholQR2
+    theory restores full orthogonality only while ``‖Q1ᴴQ1 − I‖ < 1``.
     Hermitian Gram (``xᴴx``) so complex operands factor correctly. With
-    ``calc_q=False`` the second (largest) triangular solve is skipped — R
+    ``calc_q=False`` the second (largest) formation matmul is skipped — R
     only needs the second pass's Cholesky factor."""
+    eye = jnp.eye(x.shape[1], dtype=x.dtype)
 
     def gram_chol(x):
         g = jnp.conjugate(x).mT @ x  # (n, n) — psum over the sharded rows
-        return jnp.conjugate(jnp.linalg.cholesky(g)).mT  # upper factor
+        return jnp.conjugate(jnp.linalg.cholesky(g)).mT, g  # upper factor
 
-    def solve(r, x):
-        return jax.lax.linalg.triangular_solve(r, x, left_side=False, lower=False)
+    def inv_upper(r):  # (n, n) solve against I: small, exact, off the hot path
+        return jax.lax.linalg.triangular_solve(r, eye, left_side=False, lower=False)
 
-    r1 = gram_chol(x)
-    q1 = solve(r1, x)
-    r2 = gram_chol(q1)  # re-orthonormalization pass
-    q2 = solve(r2, q1) if calc_q else None
-    return q2, r2 @ r1
+    r1, _ = gram_chol(x)
+    q1 = x @ inv_upper(r1)
+    r2, g2 = gram_chol(q1)  # re-orthonormalization pass
+    ok = _cholqr2_probe_ok(r1, r2, g2, eye)
+    q2 = q1 @ inv_upper(r2) if calc_q else None
+    return q2, r2 @ r1, ok
+
+
+def _cholqr2_probe_ok(r1, r2, g2, eye):
+    """The breakdown/conditioning acceptance scalar (see _cholqr2_kernel):
+    both Cholesky factors finite AND first-pass orthogonality error
+    ``max|Q1ᴴQ1 − I| < 0.5`` — the band where the second pass provably
+    restores orthonormality (needs < 1; 0.5 leaves margin)."""
+    ok = jnp.isfinite(r2).all() & jnp.isfinite(r1).all()
+    return ok & (jnp.max(jnp.abs(g2 - eye)) < 0.5)

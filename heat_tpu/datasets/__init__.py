@@ -1,10 +1,16 @@
 """Bundled datasets (reference: heat/datasets/ ships iris.csv/h5/nc and
 diabetes.h5 as static files for tests and examples).
 
-This package generates equivalent small datasets on demand instead of
-shipping binaries: deterministic synthetic analogs with the same shapes
-((150, 4) three-class "iris-like" blobs; (442, 10) regression "diabetes-like"
-data), plus writers to materialize them as CSV/HDF5 for I/O-path exercises.
+Two tiers:
+
+* **Real bundled files** under ``datasets/data/`` — the canonical
+  public-domain Fisher iris measurements (CSV semicolon layout, HDF5, and
+  classic-NETCDF3 ``iris.nc``) and the standardized diabetes regression data,
+  the same datasets the reference ships. Load via :func:`load_iris` /
+  :func:`load_diabetes`, or point ``ht.load`` at :func:`path` directly.
+* **Deterministic synthetic analogs** (:func:`iris_like` /
+  :func:`diabetes_like`) for tests that want a seeded generator instead of
+  fixed data, plus :func:`materialize` to write them out for I/O exercises.
 """
 
 from __future__ import annotations
@@ -17,7 +23,52 @@ import numpy as np
 from ..core import factories
 from ..core.dndarray import DNDarray
 
-__all__ = ["iris_like", "diabetes_like", "materialize"]
+__all__ = [
+    "iris_like",
+    "diabetes_like",
+    "materialize",
+    "load_iris",
+    "load_diabetes",
+    "path",
+]
+
+_DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+
+
+def path(name: str) -> str:
+    """Absolute path of a bundled dataset file (``iris.csv``, ``iris.h5``,
+    ``iris.nc``, ``iris_labels.csv``, ``diabetes.h5``) — the analog of the
+    reference's ``heat/datasets/<file>`` relative paths."""
+    p = os.path.join(_DATA_DIR, name)
+    if not os.path.exists(p):
+        raise FileNotFoundError(
+            f"no bundled dataset {name!r}; available: {sorted(os.listdir(_DATA_DIR))}"
+        )
+    return p
+
+
+def load_iris(split: Optional[int] = None, return_labels: bool = False):
+    """The real Fisher iris dataset (150, 4) from the bundled files —
+    the dataset the reference's estimator tests run on (reference
+    cluster/tests/test_kmeans.py:80 loads heat/datasets/iris.csv)."""
+    from ..core import io
+
+    data = io.load_csv(path("iris.csv"), sep=";", split=split)
+    if not return_labels:
+        return data
+    y = np.loadtxt(path("iris_labels.csv"), dtype=np.int64)
+    return data, factories.array(y.astype(np.int32), split=split)
+
+
+def load_diabetes(split: Optional[int] = None, return_y: bool = False):
+    """The real diabetes regression dataset (442, 11 incl. intercept column)
+    from the bundled HDF5 (reference heat/datasets/diabetes.h5)."""
+    from ..core import io
+
+    x = io.load_hdf5(path("diabetes.h5"), "x", split=split)
+    if not return_y:
+        return x
+    return x, io.load_hdf5(path("diabetes.h5"), "y", split=split)
 
 _IRIS_CENTERS = np.array(
     [

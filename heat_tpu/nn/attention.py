@@ -173,25 +173,24 @@ def flash_attention(
       differentiable, XLA schedules the tiles.
     * ``'pallas'`` — the hand-tiled TPU kernel (:mod:`heat_tpu.ops.flash`);
       owns the (q, k) tile grid, skips above-diagonal tiles when causal.
-      Its win over dense is memory class (O(seq) vs O(seq²)) first, speed
-      second: at 4k causal the v5e marginal rates are comparable to XLA's
-      dense attention (`benchmarks/TPU_WINDOW_r04.json` attention stage;
-      the attention_sweep stage tracks the tile schedule). Differentiable
-      via a custom VJP whose backward re-runs the scan path (same O(seq)
-      memory).
+      Its win over dense is memory class (O(seq) vs O(seq²)); on speed the
+      r04 real-v5e capture measured it at 0.44 TFLOP/s marginal vs dense's
+      0.69 at 4k causal f32 with its then-default (128, 128) tiles — a
+      0.65x REGRESSION (git-banked attention stage, r04 window; recovered
+      per VERDICT r04). Differentiable via a custom VJP whose backward
+      re-runs the scan path (same O(seq) memory).
       ``block_size`` does not apply — the kernel picks its own 128-aligned
       tiles (pass ``block_q``/``block_k`` to
       :func:`heat_tpu.ops.flash.flash_attention_tpu` directly to tune them).
-    * ``'auto'`` — ``'pallas'`` when on TPU and K/V fit the kernel's VMEM
-      budget, else ``'scan'``.
+    * ``'auto'`` — ``'scan'``, everywhere. The pallas kernel is opt-in until
+      a banked real-TPU capture shows it beating the scan path at the
+      r05 defaults (the measured-fastest path owns the default; see
+      benchmarks/tpu_window.py stage_attention / stage_attention_sweep).
     """
     if impl not in ("auto", "scan", "pallas"):
         raise ValueError(f"unknown flash impl {impl!r}")
-    if impl != "scan":
-        from ..ops.flash import pallas_attention_supported
-
-        if impl == "pallas" or pallas_attention_supported(k.shape[1], q.shape[-1]):
-            return _flash_pallas_diff(q, k, v, causal, scale)
+    if impl == "pallas":
+        return _flash_pallas_diff(q, k, v, causal, scale)
     acc = _acc_dtype(q.dtype)
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])

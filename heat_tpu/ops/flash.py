@@ -49,9 +49,16 @@ def pallas_attention_supported(seq_len: int, head_dim: int) -> bool:
 
 
 def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_k, sk, nk):
-    """One (batch·head, q-block) program: stream k/v tiles, fold online softmax."""
+    """One (batch·head, q-block) program: stream k/v tiles, fold online softmax.
+
+    bfloat16 inputs stay bfloat16 on both MXU contractions (scores and
+    values, ``preferred_element_type=f32``) — casting to f32 would halve the
+    MXU rate and double VMEM pressure; the online-softmax state (m, l, acc)
+    is always f32. The scale is folded into the q tile once, instead of
+    multiplying every (block_q, block_k) score tile."""
     iq = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # (block_q, D)
+    mm_dtype = q_ref.dtype if q_ref.dtype == jnp.bfloat16 else jnp.float32
+    q = (q_ref[0].astype(jnp.float32) * scale).astype(mm_dtype)  # (block_q, D)
     q_idx0 = iq * block_q
 
     if causal:
@@ -73,11 +80,11 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_k,
     def body(jk, carry):
         m, l, acc = carry  # m, l: (block_q, 1)
         k0 = jk * block_k
-        kb = k_ref[0, pl.ds(k0, block_k), :].astype(jnp.float32)  # (block_k, D)
-        vb = v_ref[0, pl.ds(k0, block_k), :].astype(jnp.float32)
+        kb = k_ref[0, pl.ds(k0, block_k), :].astype(mm_dtype)  # (block_k, D)
+        vb = v_ref[0, pl.ds(k0, block_k), :].astype(mm_dtype)
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # (block_q, block_k)
+        )  # (block_q, block_k); scale pre-folded into q
         k_ids = k0 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         keep = k_ids < sk  # mask sequence padding
         if causal:
@@ -91,7 +98,8 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_k,
         alpha = jnp.exp(m - m_new)  # (block_q, 1)
         l = alpha * l + jnp.sum(p, axis=1, keepdims=True)
         acc = alpha * acc + jax.lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(mm_dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         return m_new, l, acc
 
@@ -113,12 +121,19 @@ def flash_attention_tpu(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 256,
+    block_k: int = 512,
     interpret: bool = False,
 ) -> jax.Array:
     """Pallas flash attention on [B, S, H, D] inputs (same contract as
-    :func:`heat_tpu.nn.attention.flash_attention`)."""
+    :func:`heat_tpu.nn.attention.flash_attention`).
+
+    Default tiles are (256, 512): the r04 capture measured the kernel at its
+    then-default (128, 128) tiles losing 0.65x to dense at 4k causal —
+    128-wide MXU contractions are too small to amortize the per-tile
+    softmax state updates; larger tiles raise arithmetic intensity per
+    fori_loop step (benchmarks/tpu_window.py stage_attention_sweep searches
+    the schedule and records the winner)."""
     B, S, H, D = q.shape
     sk = k.shape[1]
     if scale is None:

@@ -233,10 +233,24 @@ def _kernel_call(data, centers, k: int, n_valid, interpret: bool):
 def _assign_labels(data: jax.Array, centers: jax.Array) -> jax.Array:
     """The assignment step alone, as one fused XLA pass: labels w.r.t.
     ``centers``. Runs ONCE per program as the label epilogue — per-row labels
-    are not a kernel output (module docstring)."""
-    x32 = data.astype(jnp.float32)
+    are not a kernel output (module docstring).
+
+    The score is computed in the STREAMED dtype: for bfloat16 data the dot's
+    operands stay bf16 with f32 accumulation, exactly like the kernel's
+    score contraction — an all-f32 epilogue would disagree with the bf16
+    argmin that produced the kernel's sums/counts for boundary samples, so
+    ``labels_`` could contradict ``cluster_centers_`` (advisor r04#2)."""
     c32 = centers.astype(jnp.float32)
-    score = jnp.sum(c32 * c32, axis=1)[None, :] - 2.0 * (x32 @ c32.T)
+    csq = jnp.sum(c32 * c32, axis=1)  # always from the UNQUANTIZED centers,
+    # exactly like _kernel_call_T's csq operand
+    if data.dtype == jnp.bfloat16:
+        x, c = data, c32.astype(jnp.bfloat16)
+    else:
+        x, c = data.astype(jnp.float32), c32
+    dot = jax.lax.dot_general(
+        x, c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    score = csq[None, :] - 2.0 * dot
     return jnp.argmin(score, axis=1).astype(jnp.int32)
 
 
@@ -252,6 +266,14 @@ def fused_lloyd_iter(
     ``xsq_sum`` is the loop-invariant Σ|x|²; pass it from outside an
     iteration loop, or it is computed here (costing the one extra data read
     the kernel exists to avoid).
+
+    Cost note (advisor r04#4): every call pays the ``_assign_labels``
+    epilogue — a FULL extra data pass — plus the Σ|x|² pass when ``xsq_sum``
+    is not supplied, so a Python loop over single calls reads the data ~3x
+    per iteration. Iteration loops should use :func:`fused_lloyd_run`
+    (labels once per N-step program) with :func:`prepare_run_operands`
+    hoisting the transpose/Σ|x|² across chunks — that combination is the
+    advertised one-read-per-iteration path.
     """
     n = data.shape[0]
     sumsT, counts, inertia = _kernel_call(

@@ -1,10 +1,20 @@
 #!/usr/bin/env bash
 # Run the suite at mesh sizes 1/3/5/8 — the TPU-native analog of the
 # reference CI's "mpirun -n 1,3,5,8 pytest heat/" matrix
-# (reference Jenkinsfile:24-28; SURVEY.md §4).
+# (reference Jenkinsfile:24-28; SURVEY.md §4) — with per-leg line coverage
+# (the reference's codecov flags per world size, codecov.yml:1-20;
+# Jenkinsfile:36-39) collected by scripts/heat_coverage.py and merged into
+# one report at the end.
 set -e
 cd "$(dirname "$0")/.."
+COV_DIR=${HEAT_TPU_COV_DIR:-/tmp/heat_cov}
+mkdir -p "$COV_DIR"
+legs=()
 for size in ${@:-1 3 5 8}; do
   echo "=== mesh size $size ==="
-  HEAT_TPU_TEST_DEVICES=$size python -m pytest tests/ -q -x
+  HEAT_TPU_TEST_DEVICES=$size \
+  HEAT_TPU_COVERAGE="$COV_DIR/cov_mesh$size.json" \
+    python -m pytest tests/ -q -x
+  legs+=("$COV_DIR/cov_mesh$size.json")
 done
+python scripts/heat_coverage.py merge "$COV_DIR/coverage_merged.json" "${legs[@]}"

@@ -1,28 +1,31 @@
 #!/bin/bash
-# Tunnel watcher: waits for the axon TPU tunnel to answer, then captures the
-# remaining round-4 window stages (attention marginals, cdist marginal) and
-# finishes with one fresh full bench.py so the official record carries the
+# Tunnel watcher (round 5): waits for the axon TPU tunnel to answer, then
+# captures the FULL round-5 window ladder — including every stage the r04
+# verdict flagged as never-run (lloyd_bf16, cdist, attention,
+# attention_sweep, train50, train_bf16) plus the new qr_marginal and
+# moments_diag diagnostics — and finishes with one fresh full bench.py so
+# the official record describes the FINAL tree, live, with the
 # dispatch-cost-cancelled roofline fields. Safe to re-run; exits after DONE.
 cd "$(dirname "$0")/.." || exit 1
+OUT=benchmarks/TPU_WINDOW_r05.json
 for i in $(seq 1 "${TPU_WATCH_TRIES:-40}"); do
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "=== tunnel up, attempt $i $(date -u +%H:%M:%S) ===" >> /tmp/tpu_watch.log
-    timeout 1800 python benchmarks/tpu_window.py \
-      --out benchmarks/TPU_WINDOW_r04.json --force \
-      --stages attention,cdist,train50,train_bf16,attention_sweep,capability,lloyd_bf16 \
+    timeout 3000 python benchmarks/tpu_window.py --out "$OUT" \
       >> /tmp/tpu_watch.log 2>&1
-    if python - <<'PY'
-import json, sys
-d = json.load(open("benchmarks/TPU_WINDOW_r04.json"))
+    if OUT="$OUT" python - <<'PY'
+import json, os, sys
+d = json.load(open(os.environ["OUT"]))
 ok = lambda s: isinstance(s, dict) and s and not any("error" in k for k in s)
-sys.exit(
-    0
-    if ok(d.get("attention", {})) and ok(d.get("cdist", {})) and ok(d.get("train50", {}))
-    else 1
-)
+needed = [
+    "init", "lloyd_full", "lloyd_bf16", "capability", "cholqr2", "qr_marginal",
+    "cdist", "moments_diag", "attention", "attention_sweep", "train50",
+    "train_bf16",
+]
+sys.exit(0 if all(ok(d.get(s, {})) for s in needed) else 1)
 PY
     then
-      echo "=== stages banked, running fresh bench ===" >> /tmp/tpu_watch.log
+      echo "=== all required stages banked, running fresh bench ===" >> /tmp/tpu_watch.log
       # per-attempt log: the shared append-log would let an OLD attempt's
       # record satisfy the gate for a new, failed one
       BLOG="/tmp/tpu_watch_bench_$i.log"

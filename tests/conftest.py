@@ -21,6 +21,33 @@ if _n is not None:
 elif "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = f"{_flags} --xla_force_host_platform_device_count=8".strip()
 
+_cov_out = os.environ.get("HEAT_TPU_COVERAGE")
+if _cov_out:
+    # native line coverage (scripts/heat_coverage.py): start BEFORE heat_tpu
+    # imports so module-level lines count; write at interpreter exit so the
+    # dump happens after the last test regardless of how pytest ends
+    import atexit
+    import sys as _sys
+
+    if not hasattr(_sys, "monitoring"):  # sys.monitoring is 3.12+
+        import warnings
+
+        warnings.warn(
+            "HEAT_TPU_COVERAGE set but sys.monitoring is unavailable "
+            f"(Python {_sys.version_info.major}.{_sys.version_info.minor} < 3.12); "
+            "coverage collection skipped",
+            stacklevel=1,
+        )
+    else:
+        _sys.path.insert(
+            0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "scripts")
+        )
+        import heat_coverage
+
+        _sys.path.pop(0)
+        heat_coverage.start()
+        atexit.register(heat_coverage.dump, _cov_out)
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

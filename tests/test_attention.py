@@ -179,6 +179,22 @@ def test_pallas_flash_kernel_interpret_matches_dense(causal, S, D):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
+def test_pallas_flash_kernel_interpret_bf16():
+    # bfloat16 inputs stay bf16 on both MXU contractions (the r05 kernel
+    # keeps the streamed dtype; only the online-softmax state is f32) —
+    # results must still match the f32 dense oracle to bf16 tolerance
+    from heat_tpu.ops.flash import flash_attention_tpu
+
+    q, k, v = _qkv(B=1, S=256, H=2, D=64, seed=7)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = flash_attention_tpu(qb, kb, vb, causal=True, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), rtol=0.05, atol=0.05
+    )
+
+
 def test_pallas_flash_kernel_interpret_big_blocks():
     # block_q != block_k and blocks larger than the sequence
     from heat_tpu.ops.flash import flash_attention_tpu

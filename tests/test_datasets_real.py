@@ -1,0 +1,80 @@
+"""Estimators on the REAL bundled datasets (reference pattern:
+cluster/tests/test_kmeans.py:1-152 runs on heat/datasets/iris.csv; the
+regression tests on diabetes.h5)."""
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu import datasets
+
+from harness import TestCase
+
+
+class TestBundledFiles(TestCase):
+    def test_formats_agree(self):
+        # csv, h5 and classic-NETCDF3 nc must carry the same 150x4 data
+        csv = datasets.load_iris()
+        h5 = ht.load_hdf5(datasets.path("iris.h5"), "data", dtype=ht.float64)
+        nc = ht.load_netcdf(datasets.path("iris.nc"), "data", dtype=ht.float64)
+        assert csv.shape == (150, 4)
+        np.testing.assert_allclose(h5.numpy(), nc.numpy())
+        np.testing.assert_allclose(csv.numpy(), h5.numpy().astype(np.float32), atol=1e-6)
+
+    def test_iris_values_are_the_canonical_measurements(self):
+        x = datasets.load_iris().numpy()
+        np.testing.assert_allclose(x[0], [5.1, 3.5, 1.4, 0.2], atol=1e-6)
+        np.testing.assert_allclose(x.mean(0), [5.8433, 3.054, 3.7587, 1.1987], atol=1e-3)
+
+    def test_path_unknown(self):
+        import pytest
+
+        with pytest.raises(FileNotFoundError):
+            datasets.path("nope.csv")
+
+
+class TestEstimatorsOnIris(TestCase):
+    def test_kmeans_on_iris_splits(self):
+        # reference test_kmeans.py:80-100 fits on iris at split 0 and 1
+        for split in (None, 0, 1):
+            iris = datasets.load_iris(split=split)
+            km = ht.cluster.KMeans(n_clusters=3, init="kmeans++", random_state=1)
+            km.fit(iris)
+            assert km.cluster_centers_.shape == (3, 4)
+            labels = km.predict(iris).numpy().ravel()
+            assert set(np.unique(labels)) == {0, 1, 2}
+            # iris's three species form three well-separated-enough clusters
+            assert km.inertia_ < 120.0
+
+    def test_gaussian_nb_on_iris(self):
+        x, y = datasets.load_iris(split=0, return_labels=True)
+        nb = ht.naive_bayes.GaussianNB()
+        nb.fit(x, y)
+        pred = nb.predict(x).numpy().ravel()
+        acc = (pred == datasets.load_iris(return_labels=True)[1].numpy().ravel()).mean()
+        assert acc > 0.9
+
+    def test_knn_on_iris(self):
+        x, y = datasets.load_iris(split=0, return_labels=True)
+        knn = ht.classification.KNeighborsClassifier(n_neighbors=5)
+        knn.fit(x, y)
+        pred = knn.predict(x).numpy().ravel()
+        y_np = datasets.load_iris(return_labels=True)[1].numpy().ravel()
+        assert (pred == y_np).mean() > 0.9
+
+
+class TestDiabetes(TestCase):
+    def test_lasso_on_diabetes(self):
+        # the reference's demo protocol (examples/lasso/demo.py:23-41):
+        # load diabetes.h5, normalize X by sqrt(mean(X^2, axis=0)), fit
+        x, y = datasets.load_diabetes(split=0, return_y=True)
+        assert x.shape == (442, 11) and y.shape == (442,)
+        x = x / ht.sqrt(ht.mean(x**2, axis=0))
+        lasso = ht.regression.lasso.Lasso(max_iter=100, lam=0.1)
+        lasso.fit(x, ht.reshape(y, (442, 1)))
+        assert lasso.theta is not None
+        # converged fit explains a reasonable share of the variance
+        pred = lasso.predict(x).numpy().ravel()
+        y_np = y.numpy().ravel()
+        ss_res = ((pred - y_np) ** 2).sum()
+        ss_tot = ((y_np - y_np.mean()) ** 2).sum()
+        assert 1.0 - ss_res / ss_tot > 0.3

@@ -107,13 +107,38 @@ class TestCSVErrors(TestCase):
 
 
 class TestNetCDFErrors(TestCase):
-    def test_netcdf3_rejected(self):
+    def test_netcdf3_corrupt_raises(self):
         path = _tmp("c.nc")
-        # classic NETCDF3 magic: 'CDF\x01'
+        # classic NETCDF3 magic 'CDF\x01' but a truncated/garbage body
         with open(path, "wb") as f:
             f.write(b"CDF\x01" + b"\x00" * 32)
-        with pytest.raises((ValueError, OSError, RuntimeError)):
+        # scipy parses the empty body as "no variables" (KeyError) or rejects
+        # the header outright (TypeError/ValueError), depending on truncation
+        with pytest.raises((ValueError, OSError, RuntimeError, TypeError, KeyError, IndexError)):
             ht.load_netcdf(path, variable="v")
+
+    def test_netcdf3_classic_reads(self):
+        # classic NETCDF3 (reference io.py:246-660 reads it via the netCDF4
+        # library; here scipy.io.netcdf_file) — sharded and replicated
+        import scipy.io as sio
+
+        path = _tmp("classic3.nc")
+        ref = np.arange(60, dtype=np.float32).reshape(15, 4)
+        f = sio.netcdf_file(path, "w")
+        f.createDimension("rows", 15)
+        f.createDimension("cols", 4)
+        v = f.createVariable("data", "f", ("rows", "cols"))
+        v[:] = ref
+        f.close()
+
+        x = ht.load_netcdf(path, variable="data", split=0)
+        assert x.split == 0 and x.shape == (15, 4)
+        self.assert_array_equal(x, ref)
+        rep = ht.load_netcdf(path, variable="data")
+        assert rep.split is None
+        self.assert_array_equal(rep, ref)
+        with pytest.raises(KeyError):
+            ht.load_netcdf(path, variable="nope")
 
     def test_round_trip_preserves_dtype(self):
         path = _tmp("t.nc")

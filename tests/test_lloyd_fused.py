@@ -173,6 +173,28 @@ class TestFusedLloyd(TestCase):
         # labels come from the f32 epilogue: near-exact (ties aside)
         assert (np.asarray(got[1]) == np.asarray(ref[1])).mean() > 0.97
 
+    def test_bf16_labels_consistent_with_kernel_counts(self):
+        # advisor r04#2: labels_ must agree with the assignment that produced
+        # cluster_centers_. The epilogue now scores in the STREAMED dtype
+        # (bf16 operands, f32 accumulation — the kernel's exact contraction
+        # class), so bincount(labels) must reproduce the kernel's counts.
+        import jax.numpy as jnp
+
+        from heat_tpu.ops.lloyd import _assign_labels, _kernel_call
+
+        rng = np.random.default_rng(17)
+        n, f, k = 4096, 16, 4
+        data = jnp.asarray(rng.standard_normal((n, f)).astype(np.float32)).astype(
+            jnp.bfloat16
+        )
+        centers = jnp.asarray(rng.standard_normal((k, f)).astype(np.float32) * 2)
+        _, counts, _ = _kernel_call(data, centers, k, jnp.asarray(n, jnp.int32), True)
+        labels = _assign_labels(data, centers)
+        binc = np.bincount(np.asarray(labels), minlength=k).astype(np.float32)
+        # identical scoring dtype; only summation-order ulps can differ, so
+        # demand near-exact agreement (the old f32 epilogue sat near 0.97)
+        assert np.abs(binc - np.asarray(counts)[:, 0]).sum() <= n * 0.001
+
     def test_bf16_sharded_ragged_matches_oracle(self):
         # the harshest combination: bfloat16 stream x physical pad (ragged
         # rows) x shard_map psum — accumulators must stay f32-exact w.r.t.

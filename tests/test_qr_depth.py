@@ -250,6 +250,30 @@ class TestCholQR2(TestCase):
             np.asarray(q.larray) @ np.asarray(r.larray), a_np, atol=1e-3
         )
 
+    def test_probe_rejects_finite_but_degraded_orthogonality(self):
+        # advisor r04#3: a finite Gram Cholesky is NOT sufficient — near the
+        # 1/sqrt(eps) conditioning bound Q1 drifts from orthonormal while
+        # everything stays finite. The probe must gate on ||Q1^H Q1 - I|| too.
+        # The exact operand regime where that window opens is platform- and
+        # build-sensitive, so the threshold logic is unit-tested directly.
+        import importlib
+        import jax.numpy as jnp
+
+        qr_mod = importlib.import_module("heat_tpu.core.linalg.qr")
+        probe = qr_mod._cholqr2_probe_ok
+        n = 4
+        eye = jnp.eye(n, dtype=jnp.float32)
+        r_ok = jnp.triu(jnp.ones((n, n), jnp.float32))
+        # finite factors, tiny orthogonality error: accept
+        assert bool(probe(r_ok, r_ok, eye + 1e-6, eye))
+        # finite factors, error past the 0.5 recovery band: reject
+        g_bad = eye.at[0, 1].set(0.6)
+        assert not bool(probe(r_ok, r_ok, g_bad, eye))
+        # non-finite first-pass factor: reject even with a clean-looking g2
+        r_nan = r_ok.at[0, 0].set(jnp.nan)
+        assert not bool(probe(r_nan, r_ok, eye, eye))
+        assert not bool(probe(r_ok, r_nan, eye, eye))
+
     def test_auto_square_skips_cholqr2_probe(self):
         # a square (or insufficiently tall) operand must NOT run the probe:
         # its (n, n) Gram would be a silent full-size replication

@@ -1,0 +1,120 @@
+"""The window ladder's cross-window merge must be ADDITIVE.
+
+Round 4 lost a banked real-TPU attention capture: a --force re-run died with
+the backend mid-window and the error record replaced the banked data
+(VERDICT r04, weak #2). These tests pin the invariant on the harness itself:
+a stage banked ok may only ever be replaced by a new ok record.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "benchmarks", "tpu_window.py")
+
+
+def _run(out_path, stages, force=False, extra_env=None):
+    env = os.environ.copy()
+    env["HEAT_BENCH_PLATFORM"] = "cpu"
+    env.update(extra_env or {})
+    cmd = [sys.executable, SCRIPT, "--out", str(out_path), "--stages", stages]
+    if force:
+        cmd.append("--force")
+    return subprocess.run(cmd, capture_output=True, text=True, env=env, timeout=300)
+
+
+@pytest.fixture()
+def out_file(tmp_path):
+    return tmp_path / "window.json"
+
+
+def test_banked_ok_survives_failed_force_rerun(out_file):
+    # bank a real ok stage (init runs anywhere)
+    proc = _run(out_file, "init")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(out_file.read_text())
+    assert doc["init"].get("platform")
+    banked = dict(doc["init"])
+
+    # sabotage the same stage via a monkeypatching sitecustomize-style hook:
+    # simplest robust approach — corrupt the stage by running a stage name
+    # that exists but will fail, then assert the merge kept the banked one.
+    # We simulate the failure by pre-writing a doc where 'init' is ok and
+    # re-running with a stage that fails (mosaic stages fail fast on CPU
+    # only if pallas import breaks, so instead drive main() in-process).
+    sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+    try:
+        import importlib
+
+        tw = importlib.import_module("tpu_window")
+    finally:
+        sys.path.pop(0)
+
+    def boom():
+        raise RuntimeError("synthetic window death")
+
+    orig = tw.STAGES["init"]
+    old_argv = sys.argv
+    try:
+        tw.STAGES["init"] = boom
+        sys.argv = ["tpu_window.py", "--out", str(out_file), "--stages", "init", "--force"]
+        tw.main()
+    finally:
+        tw.STAGES["init"] = orig
+        sys.argv = old_argv
+
+    doc2 = json.loads(out_file.read_text())
+    # the banked ok record is untouched; the failure is parked beside it
+    assert doc2["init"] == banked
+    assert "synthetic window death" in doc2["attempt_errors"]["init"]["error"]
+
+
+def test_partial_record_with_error_key_survives_failed_rerun(out_file):
+    # a stage that banked SOME data plus a per-path error (e.g. good f32
+    # marginals beside a bf16_error) re-runs for the retry — but a failed
+    # re-run must keep the banked data, not replace it with a bare error
+    partial = {"qr_cholqr2_tflops_marginal": 5.0, "bf16_error": "vmem", "seconds": 1.0}
+    out_file.write_text(json.dumps({"qr_marginal": partial}))
+
+    sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+    try:
+        import importlib
+
+        tw = importlib.import_module("tpu_window")
+    finally:
+        sys.path.pop(0)
+
+    def boom():
+        raise RuntimeError("tunnel died mid-stage")
+
+    orig = tw.STAGES["qr_marginal"]
+    old_argv = sys.argv
+    try:
+        tw.STAGES["qr_marginal"] = boom
+        sys.argv = ["tpu_window.py", "--out", str(out_file), "--stages", "qr_marginal"]
+        tw.main()
+    finally:
+        tw.STAGES["qr_marginal"] = orig
+        sys.argv = old_argv
+
+    doc = json.loads(out_file.read_text())
+    assert doc["qr_marginal"] == partial
+    assert "tunnel died" in doc["attempt_errors"]["qr_marginal"]["error"]
+
+
+def test_failed_stage_record_replaced_on_success_and_attempt_error_cleared(out_file):
+    # a stage that previously FAILED (no ok banked) is overwritten in place,
+    # and a later success clears any parked attempt error
+    out_file.write_text(
+        json.dumps({"init": {"error": "old failure"}, "attempt_errors": {"init": {"error": "x"}}})
+    )
+    proc = _run(out_file, "init")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(out_file.read_text())
+    assert "error" not in doc["init"]
+    assert doc["init"].get("platform")
+    assert "init" not in doc.get("attempt_errors", {})

@@ -57,7 +57,9 @@ def load_iris(split: Optional[int] = None, return_labels: bool = False):
     if not return_labels:
         return data
     y = np.loadtxt(path("iris_labels.csv"), dtype=np.int64)
-    return data, factories.array(y.astype(np.int32), split=split)
+    # the 1-D labels share the sample axis only: split=0 follows, split=1
+    # (a feature split of the 2-D data) leaves them replicated
+    return data, factories.array(y.astype(np.int32), split=0 if split == 0 else None)
 
 
 def load_diabetes(split: Optional[int] = None, return_y: bool = False):
@@ -68,7 +70,9 @@ def load_diabetes(split: Optional[int] = None, return_y: bool = False):
     x = io.load_hdf5(path("diabetes.h5"), "x", split=split)
     if not return_y:
         return x
-    return x, io.load_hdf5(path("diabetes.h5"), "y", split=split)
+    return x, io.load_hdf5(
+        path("diabetes.h5"), "y", split=0 if split == 0 else None
+    )
 
 _IRIS_CENTERS = np.array(
     [

@@ -25,6 +25,14 @@ class TestBundledFiles(TestCase):
         np.testing.assert_allclose(x[0], [5.1, 3.5, 1.4, 0.2], atol=1e-6)
         np.testing.assert_allclose(x.mean(0), [5.8433, 3.054, 3.7587, 1.1987], atol=1e-3)
 
+    def test_split1_companions_replicated(self):
+        # split=1 is a FEATURE split of the 2-D data; the 1-D labels/y have
+        # no feature axis and must come back replicated, not crash
+        x, y = datasets.load_iris(split=1, return_labels=True)
+        assert x.split == 1 and y.split is None and y.shape == (150,)
+        dx, dy = datasets.load_diabetes(split=1, return_y=True)
+        assert dx.split == 1 and dy.split is None
+
     def test_path_unknown(self):
         import pytest
 

@@ -129,6 +129,34 @@ def build_cholesky():
 
 timed("cholesky", build_cholesky)
 
+# --- jnp Lloyd iteration loop (the weak-scaling benchmark's program) ------
+from heat_tpu.cluster.kmeans import _lloyd_run
+
+def build_lloyd():
+    data = jax.device_put(jnp.zeros((4 * p, 4), jnp.float32), comm.sharding(2, 0))
+    c0 = jnp.zeros((2, 4), jnp.float32)
+    return jax.jit(lambda d, c: _lloyd_run(d, c, 2, 10)).lower(data, c0).compile().as_text()
+
+timed("lloyd10", build_lloyd)
+
+# --- lasso Gram mode: sweeps are collective-FREE, precompute pays 2 -------
+from heat_tpu.regression.lasso import _cd_sweep_gram, _gram_precompute
+
+def build_lasso_gram_precompute():
+    xt = jax.device_put(jnp.zeros((6, 4 * p), jnp.float32), comm.sharding(2, 1))
+    y = jax.device_put(jnp.zeros((4 * p, 1), jnp.float32), comm.sharding(2, 0))
+    return _gram_precompute.lower(xt, y).compile().as_text()
+
+timed("lasso_gram_pre", build_lasso_gram_precompute)
+
+def build_lasso_gram_sweep():
+    G = jnp.zeros((6, 6), jnp.float32)
+    cy = jnp.zeros((6,), jnp.float32)
+    th = jnp.zeros((6, 1), jnp.float32)
+    return _cd_sweep_gram.lower(G, cy, th, jnp.float32(0.1), 4 * p).compile().as_text()
+
+timed("lasso_gram_sweep", build_lasso_gram_sweep)
+
 print(json.dumps(out))
 """
 
@@ -153,14 +181,19 @@ class TestMesh64Compile(unittest.TestCase):
             )
         cls.out = json.loads(proc.stdout.strip().splitlines()[-1])
 
+    NAMES = (
+        "panel_qr", "sort", "exscan", "ring_sym", "tri_solve", "det", "cholesky",
+        "lloyd10", "lasso_gram_pre", "lasso_gram_sweep",
+    )
+
     def test_all_programs_compiled(self):
-        for name in ("panel_qr", "sort", "exscan", "ring_sym", "tri_solve", "det", "cholesky"):
+        for name in self.NAMES:
             self.assertIn(f"{name}_compile_s", self.out, f"{name} did not compile")
 
     def test_compile_times_bounded(self):
         # generous bound per program on a loaded CI box; the failure mode
         # being guarded (O(p)+ unrolled programs) costs minutes, not seconds
-        for name in ("panel_qr", "sort", "exscan", "ring_sym", "tri_solve", "det", "cholesky"):
+        for name in self.NAMES:
             self.assertLess(
                 self.out[f"{name}_compile_s"], 120.0,
                 f"{name} compile time blew up at mesh 64: {self.out}",
@@ -176,8 +209,19 @@ class TestMesh64Compile(unittest.TestCase):
             ("tri_solve", 6),
             ("det", 8),
             ("cholesky", 8),
+            # the weak-scaling attribution budgets (WEAK_SCALING_ATTRIBUTION
+            # _r05.json): a 10-iteration Lloyd program carries a constant
+            # handful of all-reduces, NOT 10x per-iteration growth
+            ("lloyd10", 4),
+            ("lasso_gram_pre", 2),
         ):
             self.assertLessEqual(
                 self.out[f"{name}_collective_ops"], bound,
                 f"{name} collective ops scale with p: {self.out}",
             )
+
+    def test_lasso_gram_sweep_collective_free(self):
+        # the covariance-update sweep runs on replicated (m,)-vectors only:
+        # ZERO collectives — the whole point of Gram mode (the per-feature
+        # all-reduce of the residual form was the lasso weak-scaling cost)
+        self.assertEqual(self.out["lasso_gram_sweep_collective_ops"], 0, self.out)

@@ -1,24 +1,25 @@
 """Hand-tiled pallas flash-attention kernel for TPU.
 
 The scan-based :func:`heat_tpu.nn.attention.flash_attention` leaves the tile
-schedule to XLA; this kernel owns it: the (q_block, k_block) tiling lives on
-the pallas grid, Q/K/V tiles are staged HBM→VMEM by BlockSpecs, the two
-matmuls per tile hit the MXU, and the online-softmax state (m, l, acc) stays
-in registers/VMEM across the k-loop. With ``causal=True`` the k-loop bound is
-computed from the query block's global offset, so tiles strictly above the
-diagonal are never read — a ~2x FLOP/traffic saving XLA's scan cannot express
-(its loop trip count is uniform).
+schedule to XLA; this kernel owns it: the FULL (batch·head, q_block, k_block)
+tiling lives on the pallas grid — K/V tiles are streamed HBM→VMEM one
+(block_k, D) block per grid step by BlockSpecs (so pallas double-buffers the
+fetch against the previous tile's compute, and sequence length is NOT capped
+by VMEM), the two matmuls per tile hit the MXU, and the online-softmax state
+(m, l, acc) lives in VMEM scratch that carries across the k-axis grid steps.
+With ``causal=True`` tiles strictly above the diagonal skip their compute
+via ``pl.when`` AND their K/V copies via a clamped (repeating) block index —
+half the FLOPs and half the K/V traffic at a uniform grid.
 
 The reference framework has no attention; this kernel is the long-context
 hot-op analog of its densest compute path (the cdist tile kernel,
 reference spatial/distance.py:16-134 → heat_tpu/ops/pairwise.py).
 
 Layout: heads fold into the grid's leading axis ([B, H] → programs), head_dim
-is the lane axis padded to 128, sequence is the sublane axis in (128, D)
-tiles. K/V are presented per-program as the full (padded) sequence; VMEM
-holds S·D·4·2 bytes of K+V per program, and the ``pallas_attention_supported``
-gate caps that at 8 MB (S ≈ 8k at D=128) to leave headroom in the ~16 MB
-VMEM for Q/O tiles and double buffering.
+is the lane axis padded to 128, sequence is the sublane axis in (block, D)
+tiles. VMEM holds one Q tile, one K/V tile pair (double-buffered), the
+(block_q, D) f32 accumulator and two (block_q, 128) state columns — a few
+hundred KB regardless of S.
 """
 
 from __future__ import annotations
@@ -30,6 +31,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["flash_attention_tpu", "pallas_attention_supported"]
 
@@ -38,50 +40,60 @@ _NEG_INF = -1e30  # large-negative instead of -inf: exp() underflows to 0 identi
 
 
 def pallas_attention_supported(seq_len: int, head_dim: int) -> bool:
-    """TPU backend present and K+V for one (batch, head) fit VMEM comfortably."""
+    """TPU backend present and the head fits the lane tile. K/V stream per
+    block since the r05 grid rewrite, so sequence length no longer caps the
+    kernel (the old whole-K/V-resident design topped out near 8k)."""
     try:
         on_tpu = jax.default_backend() in ("tpu", "axon")
     except Exception:  # pragma: no cover
         return False
-    d_pad = max(_LANE, -(-head_dim // _LANE) * _LANE)
-    kv_bytes = 2 * seq_len * d_pad * 4
-    return on_tpu and kv_bytes <= 8 * 1024 * 1024
+    return on_tpu and head_dim <= 4 * _LANE
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_k, sk, nk):
-    """One (batch·head, q-block) program: stream k/v tiles, fold online softmax.
+def _attn_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    *, scale, causal, block_q, block_k, sk, nk,
+):
+    """One (batch·head, q-block, k-block) grid step: fold one K/V tile into
+    the online-softmax scratch state; finalize into ``o_ref`` on the last
+    k-step. The k axis is the FASTEST grid dimension, so the scratch
+    (m, l, acc) carries one q-block's state across its k sweep.
 
     bfloat16 inputs stay bfloat16 on both MXU contractions (scores and
     values, ``preferred_element_type=f32``) — casting to f32 would halve the
-    MXU rate and double VMEM pressure; the online-softmax state (m, l, acc)
-    is always f32. The scale is folded into the q tile once, instead of
-    multiplying every (block_q, block_k) score tile."""
+    MXU rate; the state is always f32. The scale is folded into the q tile
+    once per k-step (cheap: (block_q, D) vs the (block_q, block_k) score).
+
+    The state is kept 2-D with a 128-lane minor axis ((block_q, LANE), not
+    (block_q,)): Mosaic lays 1-D vectors out with a replicated sublane, and
+    chaining max / exp / where through that layout costs a relayout per
+    k-tile — the same layout class that broke the Lloyd kernel outright
+    (ops/lloyd.py). keepdims everywhere keeps the loop relayout-free."""
     iq = pl.program_id(1)
-    mm_dtype = q_ref.dtype if q_ref.dtype == jnp.bfloat16 else jnp.float32
-    q = (q_ref[0].astype(jnp.float32) * scale).astype(mm_dtype)  # (block_q, D)
+    jk = pl.program_id(2)
     q_idx0 = iq * block_q
+    k0 = jk * block_k
 
+    @pl.when(jk == 0)
+    def _init():
+        m_ref[:, :] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:, :] = jnp.zeros_like(l_ref)
+        acc_ref[:, :] = jnp.zeros_like(acc_ref)
+
+    # causal: tiles strictly above the diagonal contribute nothing — skip
+    # their compute (the fetch is pipelined regardless; FLOPs halve)
+    live = True
     if causal:
-        # highest key index any row of this q-block may see is q_idx0+block_q-1
-        # (all-int32 arithmetic: x64 mode would otherwise promote and trip lax.div)
-        one = jnp.int32(1)
-        nk_eff = jnp.minimum(
-            jnp.int32(nk),
-            (q_idx0 + jnp.int32(block_q) + jnp.int32(block_k) - one) // jnp.int32(block_k),
-        )
-    else:
-        nk_eff = jnp.int32(nk)
+        live = k0 <= q_idx0 + (block_q - 1)
 
-    # online-softmax state is kept 2-D ((block_q, 1), not (block_q,)):
-    # Mosaic lays 1-D vectors out with a replicated sublane, and chaining
-    # max / exp / where through that layout costs a relayout per k-tile —
-    # the same layout class that broke the Lloyd kernel outright
-    # (ops/lloyd.py). keepdims everywhere keeps the loop relayout-free.
-    def body(jk, carry):
-        m, l, acc = carry  # m, l: (block_q, 1)
-        k0 = jk * block_k
-        kb = k_ref[0, pl.ds(k0, block_k), :].astype(mm_dtype)  # (block_k, D)
-        vb = v_ref[0, pl.ds(k0, block_k), :].astype(mm_dtype)
+    @pl.when(live)
+    def _tile():
+        mm_dtype = q_ref.dtype if q_ref.dtype == jnp.bfloat16 else jnp.float32
+        q = (q_ref[0].astype(jnp.float32) * scale).astype(mm_dtype)  # (block_q, D)
+        kb = k_ref[0].astype(mm_dtype)  # (block_k, D)
+        vb = v_ref[0].astype(mm_dtype)
+        m = m_ref[:, :1]  # (block_q, 1) view of the state column
+        l = l_ref[:, :1]
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (block_q, block_k); scale pre-folded into q
@@ -96,19 +108,19 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_k,
         # rows with m_new == _NEG_INF are all-masked; zero their probabilities
         p = jnp.where(m_new > _NEG_INF / 2, p, 0.0)
         alpha = jnp.exp(m - m_new)  # (block_q, 1)
-        l = alpha * l + jnp.sum(p, axis=1, keepdims=True)
-        acc = alpha * acc + jax.lax.dot_general(
+        l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:, :] = alpha * acc_ref[:, :] + jax.lax.dot_general(
             p.astype(mm_dtype), vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return m_new, l, acc
+        m_ref[:, :] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:, :] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    a0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, a0))
-    denom = jnp.where(l > 0, l, 1.0)
-    o_ref[0] = (acc / denom).astype(o_ref.dtype)
+    @pl.when(jk == nk - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        denom = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = (acc_ref[:, :] / denom).astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -150,6 +162,20 @@ def flash_attention_tpu(
     qf, kf, vf = to_bhsd(q, sq_pad), to_bhsd(k, sk_pad), to_bhsd(v, sk_pad)
     nq, nk = sq_pad // block_q, sk_pad // block_k
 
+    if causal:
+        # above-diagonal k-steps are compute-skipped by pl.when; clamping
+        # their block index to the q-block's LAST live tile makes the index
+        # repeat, and pallas skips the copy for a repeated index — so dead
+        # steps move no HBM bytes either (the old fori_loop design's
+        # never-read-above-diagonal guarantee, kept on the uniform grid)
+        def kv_index(bh, iq, jk):
+            last_live = (iq * block_q + (block_q - 1)) // block_k
+            return (bh, jnp.minimum(jk, last_live), 0)
+
+    else:
+        def kv_index(bh, iq, jk):
+            return (bh, jk, 0)
+
     out = pl.pallas_call(
         functools.partial(
             _attn_kernel,
@@ -160,14 +186,22 @@ def flash_attention_tpu(
             sk=sk,
             nk=nk,
         ),
-        grid=(B * H, nq),
+        # k is the FASTEST axis: each q-block's online-softmax state carries
+        # across its k sweep in VMEM scratch; pallas streams one K/V tile
+        # per step (double-buffered against the previous tile's matmuls)
+        grid=(B * H, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, block_q, d_pad), lambda bh, iq: (bh, iq, 0)),
-            pl.BlockSpec((1, sk_pad, d_pad), lambda bh, iq: (bh, 0, 0)),
-            pl.BlockSpec((1, sk_pad, d_pad), lambda bh, iq: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d_pad), lambda bh, iq, jk: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, d_pad), kv_index),
+            pl.BlockSpec((1, block_k, d_pad), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d_pad), lambda bh, iq: (bh, iq, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d_pad), lambda bh, iq, jk: (bh, iq, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, sq_pad, d_pad), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANE), jnp.float32),  # m (col 0 live)
+            pltpu.VMEM((block_q, _LANE), jnp.float32),  # l
+            pltpu.VMEM((block_q, d_pad), jnp.float32),  # acc
+        ],
         interpret=interpret,
     )(qf, kf, vf)
 

@@ -147,10 +147,15 @@ class TestFlashPallas:
         if jax.default_backend() == "cpu":
             # CPU test backend: unsupported -> flash_attention 'auto' = scan
             assert not pallas_attention_supported(1024, 64)
+            # sequence length no longer gates the kernel (r05 grid rewrite
+            # streams K/V per block; VMEM holds one tile pair, not the
+            # whole sequence) — only the backend/head checks remain
+            assert not pallas_attention_supported(1_000_000, 128)  # cpu backend
         else:
             assert pallas_attention_supported(1024, 64)
-        # the VMEM gate rejects huge K/V on every backend
-        assert not pallas_attention_supported(1_000_000, 128)
+            assert pallas_attention_supported(1_000_000, 128)  # S unbounded now
+        # an absurd head_dim is rejected on every backend
+        assert not pallas_attention_supported(1024, 100_000)
 
     def test_custom_vjp_grads_match_dense(self):
         import jax

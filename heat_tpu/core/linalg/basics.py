@@ -636,7 +636,15 @@ def inv(a: DNDarray) -> DNDarray:
 
 
 def _float_for(a: DNDarray):
-    if types.heat_type_is_inexact(a.dtype):
+    """Compute dtype for factorization-class kernels: ints promote to f32,
+    and so do the half floats (bfloat16/float16) — XLA's LAPACK-class
+    lowerings (lu, cholesky, qr, triangular_solve) have no half-precision
+    kernels and raise 'Unsupported dtype bfloat16'. f64/complex pass
+    through."""
+    if types.heat_type_is_inexact(a.dtype) and a.dtype not in (
+        types.bfloat16,
+        types.float16,
+    ):
         return a.dtype.jax_type()
     return types.promote_types(a.dtype, types.float32).jax_type()
 

@@ -89,7 +89,12 @@ def qr(
         raise ValueError(
             f"unknown qr method {method!r}: expected 'tsqr', 'cholqr2' or 'auto'"
         )
-    if not types.heat_type_is_inexact(a.dtype):
+    if not types.heat_type_is_inexact(a.dtype) or a.dtype in (
+        types.bfloat16,
+        types.float16,
+    ):
+        # ints AND half floats factor in f32: XLA's qr/cholesky lowerings
+        # have no half-precision kernels (factors return as float32)
         a = a.astype(types.promote_types(a.dtype, types.float32))
 
     m, n = a.shape

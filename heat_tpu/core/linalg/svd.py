@@ -59,9 +59,9 @@ def svd(a: DNDarray, full_matrices: bool = True, compute_uv: bool = True):
                 "distributed construction produces the reduced form — pass "
                 "full_matrices=False explicitly"
             )
-        local = a.larray
-        if not jnp.issubdtype(local.dtype, jnp.inexact):
-            local = local.astype(basics._float_for(a))  # promote like qr() does
+        # ints AND half floats promote to f32 (XLA's svd has no bf16/f16
+        # kernel); f32/f64/complex pass through _float_for unchanged
+        local = a.larray.astype(basics._float_for(a))
         u, s, vh = jnp.linalg.svd(local, full_matrices=True)
         mk = functools.partial(factories.array, device=a.device, comm=a.comm)
         return SVD(mk(u), mk(s), mk(vh))

@@ -144,8 +144,8 @@ def flash_attention_tpu(
     then-default (128, 128) tiles losing 0.65x to dense at 4k causal —
     128-wide MXU contractions are too small to amortize the per-tile
     softmax state updates; larger tiles raise arithmetic intensity per
-    fori_loop step (benchmarks/tpu_window.py stage_attention_sweep searches
-    the schedule and records the winner)."""
+    k-axis grid step (benchmarks/tpu_window.py stage_attention_sweep
+    searches the schedule and records the winner)."""
     B, S, H, D = q.shape
     sk = k.shape[1]
     if scale is None:

@@ -615,3 +615,36 @@ class TestEinsum(TestCase):
             ht.einsum(np.eye(2), np.eye(2))
         with pytest.raises(TypeError):
             ht.einsum("ij,jk->ik", np.eye(2), np.eye(2))  # no DNDarray operand
+
+
+class TestHalfPrecisionFactorizations(TestCase):
+    def test_bf16_operands_factor_in_f32(self):
+        # XLA's LAPACK-class lowerings (lu/cholesky/qr/svd/triangular_solve)
+        # have no half-precision kernels — every factorization entry point
+        # must promote bfloat16/float16 operands to f32 instead of raising
+        # "Unsupported dtype bfloat16" (latent crash found in r05)
+        rng = np.random.default_rng(42)
+        m_np = rng.standard_normal((8, 8)).astype(np.float32)
+        spd_np = m_np @ m_np.T + 8.0 * np.eye(8, dtype=np.float32)
+        m = ht.array(m_np, split=0).astype(ht.bfloat16)
+        spd = ht.array(spd_np, split=0).astype(ht.bfloat16)
+        tall = ht.array(
+            rng.standard_normal((64, 8)).astype(np.float32), split=0
+        ).astype(ht.bfloat16)
+        rhs = ht.array(rng.standard_normal((8, 2)).astype(np.float32), split=0).astype(
+            ht.bfloat16
+        )
+
+        q, r = ht.linalg.qr(tall)
+        qn = np.asarray(q.larray, dtype=np.float32)
+        np.testing.assert_allclose(qn.T @ qn, np.eye(8), atol=2e-2)
+        for method in ("tsqr", "cholqr2"):
+            ht.linalg.qr(tall, method=method)
+        assert np.isfinite(float(ht.linalg.det(m)))
+        ht.linalg.cholesky(spd)
+        ht.linalg.solve(spd, rhs)
+        ht.linalg.inv(spd)
+        ht.linalg.slogdet(m)
+        s = ht.linalg.svd(ht.array(m_np).astype(ht.bfloat16)).S
+        assert s.dtype == ht.float32
+        ht.linalg.lstsq(tall, ht.sum(tall, axis=1))

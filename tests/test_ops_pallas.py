@@ -124,6 +124,24 @@ class TestFlashPallas:
         out = np.asarray(flash_attention_tpu(q, k, v, causal=causal, interpret=True))
         np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_many_block_grid_parity(self, causal):
+        # the r05 grid rewrite's moving parts — scratch init at jk==0,
+        # carry across the k sweep, finalize at jk==nk-1, and the causal
+        # clamped kv_index — only engage with MANY k/q blocks: 1024/128
+        # gives an 8x8 block grid per (batch, head)
+        from heat_tpu.nn.attention import dot_product_attention
+        from heat_tpu.ops.flash import flash_attention_tpu
+
+        q, k, v = self._qkv(B=1, S=1024, H=2, D=16, seed=3)
+        ref = np.asarray(dot_product_attention(q, k, v, causal=causal))
+        out = np.asarray(
+            flash_attention_tpu(
+                q, k, v, causal=causal, block_q=128, block_k=128, interpret=True
+            )
+        )
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
     def test_cross_attention_lengths(self):
         import jax
         import jax.numpy as jnp

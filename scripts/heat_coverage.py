@@ -140,7 +140,13 @@ def merge_main(out_path: str, leg_paths: list) -> dict:
 
 if __name__ == "__main__":
     if len(sys.argv) >= 4 and sys.argv[1] == "merge":
-        rep = merge_main(sys.argv[2], sys.argv[3:])
+        argv = sys.argv[2:]
+        fail_under = None
+        if "--fail-under" in argv:  # the gate half of the reference's codecov
+            i = argv.index("--fail-under")
+            fail_under = float(argv[i + 1])
+            del argv[i : i + 2]
+        rep = merge_main(argv[0], argv[1:])
         print(
             f"total: {rep['total_pct']}% "
             f"({rep['total_covered']}/{rep['total_lines']} lines, "
@@ -149,6 +155,9 @@ if __name__ == "__main__":
         )
         for m in rep["below_60pct"]:
             print(f"  <60%: {m}")
+        if fail_under is not None and rep["total_pct"] < fail_under:
+            print(f"FAIL: total {rep['total_pct']}% < --fail-under {fail_under}%")
+            sys.exit(1)
     else:
         print(__doc__)
         sys.exit(2)

@@ -777,7 +777,17 @@ def stage_attention_sweep():
     att_flops = 4.0 * B * H * S * S * D / 2
     out = {}
     best_rate, best_cfg = 0.0, None
-    for bq, bk in ((128, 128), (128, 512), (256, 256), (256, 512), (512, 512)):
+    for bq, bk in (
+        (128, 128),
+        (128, 512),
+        (256, 256),
+        (256, 512),
+        (512, 512),
+        # larger k tiles became legal with the r05 streamed-K grid (VMEM
+        # holds one tile pair, not the sequence)
+        (256, 1024),
+        (512, 1024),
+    ):
         def att(qq, kk_, vv, bq=bq, bk=bk):
             return flash_attention_tpu(qq, kk_, vv, causal=True, block_q=bq, block_k=bk)
 

@@ -336,21 +336,47 @@ def _cholqr2_kernel(x, calc_q: bool = True):
     theory restores full orthogonality only while ``‖Q1ᴴQ1 − I‖ < 1``.
     Hermitian Gram (``xᴴx``) so complex operands factor correctly. With
     ``calc_q=False`` the second (largest) formation matmul is skipped — R
-    only needs the second pass's Cholesky factor."""
-    eye = jnp.eye(x.shape[1], dtype=x.dtype)
+    only needs the second pass's Cholesky factor.
+
+    Half-precision operands STREAM at their own width: a bfloat16/float16
+    ``x`` keeps its dtype on the big matmul operands (half the HBM bytes;
+    Q comes back in the streamed dtype) while the Gram accumulates in f32
+    (``preferred_element_type``) and the small Cholesky/inverse run f32 —
+    XLA has no half-precision LAPACK kernels, and bf16 accumulation would
+    be numerically void. The probe inherits the arithmetic honestly: the
+    ~1e-2 bf16 quantization noise bounds the accepted conditioning far
+    tighter than f32's (cond ≲ a few tens), which is the correct contract
+    for a squared-condition algorithm on half-precision data. The public
+    ``qr()`` never routes half dtypes here (it promotes to f32); this path
+    serves callers that explicitly want the half-width stream
+    (benchmarks/tpu_window.py stage_qr_marginal's bf16 variant)."""
+    acc_t = (
+        jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else x.dtype
+    )
+    eye = jnp.eye(x.shape[1], dtype=acc_t)
 
     def gram_chol(x):
-        g = jnp.conjugate(x).mT @ x  # (n, n) — psum over the sharded rows
+        # (n, n) — contracts the (sharded) row axis; psum under GSPMD
+        g = jax.lax.dot_general(
+            jnp.conjugate(x), x, (((0,), (0,)), ((), ())),
+            preferred_element_type=acc_t,
+        )
         return jnp.conjugate(jnp.linalg.cholesky(g)).mT, g  # upper factor
 
     def inv_upper(r):  # (n, n) solve against I: small, exact, off the hot path
         return jax.lax.linalg.triangular_solve(r, eye, left_side=False, lower=False)
 
+    def form_q(x, r_inv):  # big GEMM; operands in the streamed dtype
+        return jax.lax.dot_general(
+            x, r_inv.astype(x.dtype), (((1,), (0,)), ((), ())),
+            preferred_element_type=acc_t,
+        ).astype(x.dtype)
+
     r1, _ = gram_chol(x)
-    q1 = x @ inv_upper(r1)
+    q1 = form_q(x, inv_upper(r1))
     r2, g2 = gram_chol(q1)  # re-orthonormalization pass
     ok = _cholqr2_probe_ok(r1, r2, g2, eye)
-    q2 = q1 @ inv_upper(r2) if calc_q else None
+    q2 = form_q(q1, inv_upper(r2)) if calc_q else None
     return q2, r2 @ r1, ok
 
 

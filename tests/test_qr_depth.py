@@ -250,6 +250,28 @@ class TestCholQR2(TestCase):
             np.asarray(q.larray) @ np.asarray(r.larray), a_np, atol=1e-3
         )
 
+    def test_bf16_stream_kernel(self):
+        # the raw kernel's half-width stream (stage_qr_marginal's bf16
+        # variant): operand/Q stay bfloat16, Gram accumulates f32, the
+        # small Cholesky/inverse run f32 — and the probe accepts a
+        # well-conditioned operand at bf16's own noise floor
+        import importlib
+        import jax
+        import jax.numpy as jnp
+
+        qr_mod = importlib.import_module("heat_tpu.core.linalg.qr")
+        x = jax.random.normal(jax.random.PRNGKey(0), (512, 16), jnp.float32).astype(
+            jnp.bfloat16
+        )
+        q, r, ok = qr_mod._cholqr2_kernel(x)
+        assert bool(ok)
+        assert q.dtype == jnp.bfloat16 and r.dtype == jnp.float32
+        qn = np.asarray(q, np.float32)
+        assert np.abs(qn.T @ qn - np.eye(16)).max() < 0.03  # bf16 ulp class
+        np.testing.assert_allclose(
+            qn @ np.asarray(r), np.asarray(x, np.float32), atol=0.15
+        )
+
     def test_probe_rejects_finite_but_degraded_orthogonality(self):
         # advisor r04#3: a finite Gram Cholesky is NOT sufficient — near the
         # 1/sqrt(eps) conditioning bound Q1 drifts from orthonormal while

@@ -847,24 +847,29 @@ def stage_train_bf16():
     )
 
 
+# dict order IS capture order: a tunnel window can die at any minute, so the
+# ladder banks the round's highest-value stages first — the primary bench
+# config (lloyd), the roofline triad, then every stage the r04 verdict
+# flagged as never-run; the mosaic bisection probes are failure diagnostics
+# and run only after the product stages have had their chance
 STAGES = {
     "init": stage_init,
-    "mosaic_probe": stage_mosaic_probe,
-    "mosaic_narrow": stage_mosaic_narrow,
-    "mosaic_variants": stage_mosaic_variants,
+    "capability": stage_capability,
     "lloyd_small": stage_lloyd_small,
     "lloyd_full": stage_lloyd_full,
     "lloyd_bf16": stage_lloyd_bf16,
-    "capability": stage_capability,
-    "cholqr2": stage_cholqr2,
-    "qr_marginal": stage_qr_marginal,
     "cdist": stage_cdist,
     "moments_diag": stage_moments_diag,
+    "qr_marginal": stage_qr_marginal,
+    "cholqr2": stage_cholqr2,
     "attention": stage_attention,
+    "attention_sweep": stage_attention_sweep,
     "train50": stage_train50,
     "train_bf16": stage_train_bf16,
-    "attention_sweep": stage_attention_sweep,
     "train": stage_train,
+    "mosaic_probe": stage_mosaic_probe,
+    "mosaic_narrow": stage_mosaic_narrow,
+    "mosaic_variants": stage_mosaic_variants,
 }
 
 

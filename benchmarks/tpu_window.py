@@ -64,6 +64,14 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 
+def _sz(full, smoke):
+    """Stage size: the real capture shape, or a tiny one under
+    HEAT_WINDOW_SMOKE=1 (CPU shakeout of the whole ladder before a tunnel
+    window is spent on it — a stage that crashes on shapes/plumbing must be
+    caught here, not on the chip)."""
+    return smoke if os.environ.get("HEAT_WINDOW_SMOKE") == "1" else full
+
+
 def _bank(out_path: str, doc: dict) -> None:
     tmp = out_path + ".tmp"
     with open(tmp, "w") as fh:
@@ -280,7 +288,7 @@ def stage_lloyd_full():
     from heat_tpu.cluster.kmeans import _lloyd_run
     from heat_tpu.ops.lloyd import fused_lloyd_run
 
-    n, f, k, iters = 10_000_000, 16, 8, 10
+    n, f, k, iters = _sz(10_000_000, 20_000), 16, 8, 10
     data = jax.random.normal(jax.random.PRNGKey(1), (n, f), dtype=jnp.float32)
     centers = jax.random.normal(jax.random.PRNGKey(2), (k, f), dtype=jnp.float32) * 3
     out = {"n": n}
@@ -317,7 +325,7 @@ def stage_lloyd_bf16():
 
     from heat_tpu.ops.lloyd import fused_lloyd_run
 
-    n, f, k, iters = 10_000_000, 16, 8, 10
+    n, f, k, iters = _sz(10_000_000, 20_000), 16, 8, 10
     data = jax.random.normal(jax.random.PRNGKey(1), (n, f), dtype=jnp.float32).astype(
         jnp.bfloat16
     )
@@ -354,7 +362,7 @@ def stage_capability():
         return max(best - rtt, 1e-9)
 
     for dtype, name in ((jnp.bfloat16, "bf16"), (jnp.float32, "f32")):
-        n = 4096
+        n = _sz(4096, 256)
         a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.float32).astype(dtype)
         b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.float32).astype(dtype)
         mm = jax.jit(lambda x, y: (x @ y).astype(jnp.float32))
@@ -383,7 +391,7 @@ def stage_capability():
         if marg:
             out[f"matmul_{name}_{n}_tflops_marginal"] = round(flops / marg / 1e12, 2)
 
-    n = 64 * 1024 * 1024
+    n = _sz(64 * 1024 * 1024, 1024 * 1024)
     x = jax.random.normal(jax.random.PRNGKey(2), (n,), jnp.float32)
     y = jax.random.normal(jax.random.PRNGKey(3), (n,), jnp.float32)
     triad = jax.jit(lambda a, b: (a * 1.5 + b).sum())
@@ -425,7 +433,7 @@ def stage_cholqr2():
     import heat_tpu as ht
 
     comm = ht.get_comm()
-    m, n = (1 << 21), 256
+    m, n = _sz(1 << 21, 1 << 13), _sz(256, 32)
     a = ht.array(
         jax.device_put(
             jax.random.normal(jax.random.PRNGKey(4), (m, n), dtype=jnp.float32),
@@ -463,7 +471,7 @@ def stage_qr_marginal():
 
     from heat_tpu.core.linalg.qr import _cholqr2_kernel
 
-    m, n = (1 << 21), 256
+    m, n = _sz(1 << 21, 1 << 13), _sz(256, 32)
     x = jax.random.normal(jax.random.PRNGKey(4), (m, n), dtype=jnp.float32)
     flops = 2.0 * m * n * n  # the 2mn^2 billing every qr number in this repo uses
 
@@ -534,7 +542,7 @@ def stage_cdist():
 
     from heat_tpu.spatial.distance import _euclidian_fast
 
-    n, f = 32768, 64
+    n, f = _sz(32768, 1024), 64
     x = jax.random.normal(jax.random.PRNGKey(5), (n, f), jnp.float32)
 
     def chained(reps):
@@ -632,11 +640,11 @@ def stage_moments_diag():
 
         return run
 
-    c1, cN = chain(1), chain(2048)
+    c1, cN = chain(1), chain(_sz(2048, 64))
     mop = mom.larray
     b1 = _timeit(lambda: float(c1(mop)), lambda r: r, reps=2)
     bN = _timeit(lambda: float(cN(mop)), lambda r: r, reps=2)
-    marg = _marginal_sec(b1, bN, 2047)
+    marg = _marginal_sec(b1, bN, _sz(2048, 64) - 1)
     if marg:
         out["moments_device_us_marginal"] = round(marg * 1e6, 2)
         # 2 reduction passes (mean, centered squares) + the chained operand
@@ -659,7 +667,7 @@ def stage_attention():
     from heat_tpu.nn.attention import flash_attention as scan_flash
     from heat_tpu.ops.flash import flash_attention_tpu as flash_attention
 
-    B, S, H, D = 1, 4096, 8, 128
+    B, S, H, D = 1, _sz(4096, 512), _sz(8, 2), _sz(128, 32)
     q, k, v = (
         jax.random.normal(kk, (B, S, H, D), jnp.float32)
         for kk in jax.random.split(jax.random.PRNGKey(4), 3)
@@ -721,7 +729,7 @@ def _train_one_model(model, name: str) -> dict:
 
     comm = ht.get_comm()
     n_dev = comm.size
-    batch = 256 // n_dev * n_dev or n_dev
+    batch = _sz(256, 16) // n_dev * n_dev or n_dev
     rng = np.random.default_rng(0)
     x_np = rng.standard_normal((batch, 32, 32, 3)).astype(np.float32)
     y_np = rng.integers(0, 10, size=batch).astype(np.int32)
@@ -769,7 +777,7 @@ def stage_attention_sweep():
 
     from heat_tpu.ops.flash import flash_attention_tpu
 
-    B, S, H, D = 1, 4096, 8, 128
+    B, S, H, D = 1, _sz(4096, 512), _sz(8, 2), _sz(128, 32)
     q, k, v = (
         jax.random.normal(kk, (B, S, H, D), jnp.float32)
         for kk in jax.random.split(jax.random.PRNGKey(4), 3)

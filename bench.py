@@ -10,6 +10,9 @@ Tracked configs of BASELINE.md measured here:
   * config 4 (extra field): tall-skinny TSQR throughput, TFLOP/s
     (2mn^2 FLOP model).
   * achieved TFLOP/s of the fused Lloyd iteration (extra field).
+  * eager_chain_ops_per_sec (extra field): dispatch rate of a representative
+    10-op eager chain under the fusion engine (core/fusion.py), side by side
+    with the HEAT_TPU_FUSION=0 unfused rate.
 
 ``vs_baseline`` is the measured speedup over a torch-CPU implementation of
 the same Lloyd iteration at the same problem size on this machine (the
@@ -275,6 +278,59 @@ def worker() -> None:
         mom_best = min(mom_best, time.perf_counter() - start)
     moments_ms = mom_best * 1e3
 
+    # -- eager op-chain dispatch rate (core/fusion.py) ---------------------
+    # a representative 10-op elementwise+reduce chain on a small split array:
+    # dispatch-bound by construction. Fused (default) should approach one
+    # cached program dispatch per chain; the HEAT_TPU_FUSION=0 leg pays one
+    # dispatch per op — the ratio is the fusion engine's win.
+    from heat_tpu.core import fusion as _fusion
+
+    chain_fused = chain_unfused = None
+    try:
+        cn = max((2048 // comm.size) * comm.size, comm.size)
+        ca = ht.array(
+            jax.device_put(
+                jax.random.normal(jax.random.PRNGKey(5), (cn, 4), dtype=jnp.float32),
+                comm.sharding(2, 0),
+            ),
+            is_split=0,
+        )
+        cb = ht.array(
+            jax.device_put(
+                jax.random.normal(jax.random.PRNGKey(6), (cn, 4), dtype=jnp.float32),
+                comm.sharding(2, 0),
+            ),
+            is_split=0,
+        )
+
+        def _chain_once():
+            c = (ca + cb) * 2.0       # 1, 2
+            c = ht.exp(c)             # 3
+            c = c - cb                # 4
+            d = ht.abs(c)             # 5
+            e = d + ca                # 6
+            f = ht.sqrt(ht.abs(e))    # 7, 8
+            g = f / (d + 1.0)         # ~9 (the +1.0 rides the same dispatch class)
+            h = g * cb
+            return float(ht.sum(h).larray)  # 10: reduction + the one sync
+
+        def _chain_rate():
+            _chain_once()  # warm: compile/caches
+            reps = 10
+            best = float("inf")
+            for _ in range(3):
+                start = time.perf_counter()
+                for _ in range(reps):
+                    _chain_once()
+                best = min(best, time.perf_counter() - start)
+            return 10.0 * reps / best
+
+        chain_fused = _chain_rate()
+        with _fusion.disabled():
+            chain_unfused = _chain_rate()
+    except Exception:  # noqa: BLE001 - diagnostics must never cost the record
+        pass
+
     # -- tall-skinny QR (config 4) -----------------------------------------
     qa = ht.array(
         jax.device_put(
@@ -314,6 +370,12 @@ def worker() -> None:
         "qr_tflops": round(qr_tflops, 3),
         "qr_shape": [qr_m, QR_N],
     }
+    if chain_fused:
+        record["eager_chain_ops_per_sec"] = round(chain_fused, 1)
+    if chain_unfused:
+        record["eager_chain_ops_per_sec_unfused"] = round(chain_unfused, 1)
+        if chain_fused:
+            record["eager_chain_fused_vs_unfused"] = round(chain_fused / chain_unfused, 2)
     annotate_roofline(record)
     # the COMPLETE record is banked before any diagnostics run: a hang below
     # costs only the diagnostic fields, never the tracked configs

@@ -4,6 +4,7 @@ Mirrors the reference's flat re-export layout (heat/core/__init__.py:5-32):
 everything is importable as ``heat_tpu.<name>``.
 """
 
+from . import _compat  # install jax compatibility shims FIRST (jax.shard_map)
 from .communication import *
 from . import communication
 from .devices import *
@@ -15,6 +16,7 @@ from .version import __version__
 from .constants import *
 from .base import *
 from .stride_tricks import *
+from . import fusion
 from .dndarray import *
 from .factories import *
 from .memory import *

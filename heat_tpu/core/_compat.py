@@ -1,0 +1,50 @@
+"""Compatibility shims for the jax release the environment provides.
+
+The codebase targets the modern ``jax.shard_map(..., check_vma=...)`` entry
+point (jax >= 0.6). Older releases (e.g. 0.4.x, as baked into this image)
+only ship ``jax.experimental.shard_map.shard_map`` with the ``check_rep``
+keyword — same semantics (disable the per-output replication/VMA check).
+Importing this module (done first thing in ``heat_tpu.core``) installs a
+forwarding ``jax.shard_map`` when it is absent, so every kernel call site
+can use the one modern spelling.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None, check_vma=True, **kwargs):
+        """Forward to ``jax.experimental.shard_map.shard_map``.
+
+        The legacy ``check_rep`` replication checker is force-disabled: it
+        predates the VMA system the kernels here are written against, and it
+        rejects valid programs (e.g. ring-attention's scan carries) with
+        "mismatched replication types ... as a temporary workaround pass
+        check_rep=False" — its own suggested workaround. ``check_rep`` only
+        toggles a validation pass, never results."""
+        kwargs.pop("check_rep", None)
+        del check_vma
+        return _shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_rep=False,
+            **kwargs,
+        )
+
+    jax.shard_map = shard_map
+
+if not hasattr(jax.lax, "pcast"):
+
+    def _pcast(x, axis_name=None, *, to=None):
+        """Modern ``jax.lax.pcast`` marks a value's varying-manual-axes set
+        for the VMA checker; with the legacy checker disabled (see above)
+        the marking is a semantic no-op — identity."""
+        del axis_name, to
+        return x
+
+    jax.lax.pcast = _pcast

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import devices, sanitation, types
+from . import devices, fusion, sanitation, types
 from .communication import sanitize_comm
 from .dndarray import DNDarray, _ensure_split
 from .stride_tricks import broadcast_shape, sanitize_axis
@@ -64,6 +64,16 @@ def __binary_op(
     # integers -> float) is preserved rather than clobbered afterwards.
     out_dtype = types.result_type(t1, t2)
     jt = out_dtype.jax_type()
+
+    # fusion recorder (core/fusion.py): defer the op into the expression DAG
+    # instead of dispatching it; the whole chain becomes one cached jitted
+    # program at the next forcing point. Ineligible combinations (out=/where=
+    # buffers, padded broadcasts, tracer payloads, unhashable kwargs) fall
+    # through to the eager engine below unchanged.
+    if out is None and where is None and fusion.active() and fusion.hashable_kwargs(fn_kwargs):
+        lazy = fusion.defer_binary(operation, t1, t2, jt, fn_kwargs)
+        if lazy is not None:
+            return lazy
 
     # pad-aware fast path: identical-layout ragged operands (or ragged⊗scalar)
     # compute directly on the physical payloads — the padding suffix computes
@@ -152,6 +162,14 @@ def __local_op(
     _operations.py:305-376). Promotes exact types to floating unless
     ``no_cast``."""
     sanitation.sanitize_in(x)
+    # fusion recorder: shape-preserving unary ops defer into the chain DAG
+    if out is None and fusion.active():
+        promote = None
+        if not no_cast and types.heat_type_is_exact(x.dtype):
+            promote = types.promote_types(x.dtype, types.float32).jax_type()
+        lazy = fusion.defer_local(operation, x, promote, kwargs)
+        if lazy is not None:
+            return lazy
     padded = x.padded
     # pad-aware fast path: elementwise on the physical payload; the padding
     # suffix computes garbage that stays in the padding (SURVEY.md §7)
@@ -227,6 +245,15 @@ def __reduce_op(
     else:
         out_split = split - sum(1 for a in axes if a < split)
 
+    # fusion recorder: reductions defer too, so a chain ending in (or mixing)
+    # k reductions costs one program + one device sync at the forcing point
+    # instead of k dispatches (``initial`` is accepted-and-ignored exactly as
+    # in the eager path below)
+    if out is None and fusion.active():
+        lazy = fusion.defer_reduce(partial_op, x, axis, keepdims, out_split, dtype, kwargs)
+        if lazy is not None:
+            return lazy
+
     # pad-aware fast path: reducing only non-split axes of a ragged array —
     # the padding suffix reduces into the (shifted) padding suffix of the
     # result, so the physical payload can be reduced directly with no
@@ -287,6 +314,11 @@ def __cum_op(
     axis = sanitize_axis(x.shape, axis)
     if not isinstance(axis, int):
         raise TypeError("axis must be a single integer for cumulative operations")
+    # fusion recorder: cumulative ops are shape-preserving and pad-safe
+    if out is None and fusion.active():
+        lazy = fusion.defer_cum(operation, x, axis, dtype)
+        if lazy is not None:
+            return lazy
     # pad-aware fast path: the padding is a *suffix* of the global split dim,
     # so a cumulative op along ANY axis leaves the data region untouched —
     # along the split axis the garbage only accumulates past position n,

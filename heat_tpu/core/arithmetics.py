@@ -277,9 +277,10 @@ def lcm(t1, t2, out=None, where=None) -> DNDarray:
 
 
 def nan_to_num(a, nan=0.0, posinf=None, neginf=None, out=None) -> DNDarray:
-    """Replace NaN/Inf with finite numbers (reference arithmetics.py:702)."""
+    """Replace NaN/Inf with finite numbers (reference arithmetics.py:702).
+    The replacement values ride as static kwargs (cacheable under fusion)."""
     return _local_op(
-        lambda x: jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf), a, out=out, no_cast=True
+        jnp.nan_to_num, a, out=out, no_cast=True, nan=nan, posinf=posinf, neginf=neginf
     )
 
 

@@ -241,6 +241,7 @@ class MeshCommunication(Communication):
         self._devices = tuple(devices)
         self.axis_name = axis_name
         self.mesh = Mesh(np.asarray(self._devices), (axis_name,))
+        self.__sharding_cache = {}
         try:
             self.rank = jax.process_index()
         except Exception:  # pragma: no cover
@@ -275,8 +276,14 @@ class MeshCommunication(Communication):
     def sharding(self, ndim: int, split: Optional[int]) -> NamedSharding:
         """NamedSharding realizing a 1-D block distribution along ``split``
         (the TPU equivalent of the reference's split attribute semantics,
-        reference communication.py:193-203)."""
-        return NamedSharding(self.mesh, self.spec(ndim, split))
+        reference communication.py:193-203). Memoized per (ndim, split):
+        every engine call and fusion forcing point asks for one."""
+        key = (ndim, split)
+        cached = self.__sharding_cache.get(key)
+        if cached is None:
+            cached = NamedSharding(self.mesh, self.spec(ndim, split))
+            self.__sharding_cache[key] = cached
+        return cached
 
     # ------------------------------------------------------------------
     # block-distribution arithmetic (reference communication.py:161-209)

@@ -12,8 +12,10 @@ __all__ = ["angle", "conj", "conjugate", "imag", "real"]
 
 
 def angle(x, deg: bool = False, out=None) -> DNDarray:
-    """Argument of complex values (reference complex_math.py:14)."""
-    return _local_op(lambda a: jnp.angle(a, deg=deg), x, out=out, no_cast=True)
+    """Argument of complex values (reference complex_math.py:14). ``deg``
+    rides as a static kwarg (a per-call lambda would defeat the fusion
+    engine's program cache)."""
+    return _local_op(jnp.angle, x, out=out, no_cast=True, deg=deg)
 
 
 def conjugate(x, out=None) -> DNDarray:

@@ -27,6 +27,10 @@ Key semantic notes
 * "In-place" methods (``resplit_``, ``balance_``, ``__setitem__``) mutate the
   wrapper's handle to a new immutable ``jax.Array`` — aliasing differs from
   the reference (documented deviation).
+* Under the eager fusion engine (``core/fusion.py``) the payload may
+  transiently be a recorded-but-undispatched ``fusion.LazyArray`` expression
+  chain; ``parray``/``larray`` are the forcing points that materialize it as
+  one cached jitted program. No public API ever returns unmaterialized state.
 """
 
 from __future__ import annotations
@@ -40,7 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import communication as comm_module
-from . import devices, types
+from . import devices, fusion, types
 from .communication import Communication, MeshCommunication
 from .stride_tricks import sanitize_axis
 
@@ -183,22 +187,50 @@ class DNDarray:
         )
 
     @property
+    def _payload(self):
+        """Internal: the raw stored payload WITHOUT forcing — a ``jax.Array``
+        or, while a recorded op chain is pending, a ``fusion.LazyArray``.
+        Only the fusion recorder should consume this; everything else goes
+        through :attr:`parray`/:attr:`larray`, which force."""
+        return self.__array
+
+    @property
     def parray(self) -> jax.Array:
         """The *physical* payload: the stored ``jax.Array``, zero-padded along
         the split axis to ``p * ceil(n/p)`` when the global size is ragged.
         Pad-aware fast paths (elementwise engines, shard_map kernels) may
-        compute on it directly; the padding region's content is unspecified."""
-        return self.__array
+        compute on it directly; the padding region's content is unspecified.
+
+        FORCING POINT: a pending recorded op chain (``fusion.LazyArray``
+        payload) is materialized here as one cached jitted program and the
+        result is placed under the split sharding; every payload consumer
+        (``larray``, ``numpy()``, indexing, printing, I/O, collectives,
+        linalg, the eager engine fallbacks) funnels through this property."""
+        arr = self.__array
+        if isinstance(arr, fusion.LazyArray):
+            arr = fusion.force(arr)
+            if isinstance(arr, jax.core.Tracer):
+                # forced inside an enclosing trace: the value belongs to that
+                # trace — hand it over but never store it on the wrapper
+                return arr
+            split = self.__split
+            if split is not None and (arr.ndim == 0 or split >= arr.ndim):
+                split = None
+            arr = _ensure_split(arr, split, self.__comm)
+            self.__array = arr
+        return arr
 
     @property
     def larray(self) -> jax.Array:
         """The **logical** global ``jax.Array`` (see module docstring): the
-        physical payload with any split-axis suffix padding sliced off."""
+        physical payload with any split-axis suffix padding sliced off.
+        Forces a pending recorded chain (see :attr:`parray`)."""
+        arr = self.parray
         if not self.padded:
-            return self.__array
-        idx = [slice(None)] * self.__array.ndim
+            return arr
+        idx = [slice(None)] * arr.ndim
         idx[self.__split] = slice(0, self.__gshape[self.__split])
-        return self.__array[tuple(idx)]
+        return arr[tuple(idx)]
 
     @larray.setter
     def larray(self, array: jax.Array):
@@ -247,13 +279,14 @@ class DNDarray:
         """Per-device **logical** local shards (host copies), in device order:
         each physical shard with its padding rows sliced off (tail devices of
         a ragged split may hold empty logical shards)."""
+        phys = self.parray
         if not self.padded:
-            return [np.asarray(s.data) for s in self.__array.addressable_shards]
+            return [np.asarray(s.data) for s in phys.addressable_shards]
         split = self.__split
         counts, _ = self.__comm.counts_displs_shape(self.__gshape, split)
-        block = int(self.__array.shape[split]) // self.__comm.size
+        block = int(phys.shape[split]) // self.__comm.size
         out = []
-        for s in self.__array.addressable_shards:
+        for s in phys.addressable_shards:
             start = s.index[split].start or 0
             rank = start // block if block else 0
             idx = [slice(None)] * self.__array.ndim
@@ -353,10 +386,15 @@ class DNDarray:
         axis = sanitize_axis(self.__gshape, axis)
         if axis == self.__split:
             return self
+        was_padded = self.padded
         logical = self.larray
         self.__split = axis
         if axis is not None and self.__gshape[axis] % self.__comm.size != 0:
             self.__array = _pad_and_place(logical, axis, self.__comm)
+        elif was_padded:
+            # the old payload was padded, so ``logical`` is a fresh slice no
+            # caller can hold — donate its buffer to the reshard program
+            self.__array = _reshard_donating(logical, axis, self.__comm)
         else:
             self.__array = _ensure_split(logical, axis, self.__comm)
         return self
@@ -391,7 +429,7 @@ class DNDarray:
         self.__halo_size = halo_size
         self.__halo_cache = None
         if halo_size > 0 and self.__split is not None and self.__comm.size > 1:
-            phys = self.__array
+            phys = self.parray
             block = int(phys.shape[self.__split]) // self.__comm.size
             if 0 < halo_size <= block:
                 fn = _halo_program(
@@ -415,15 +453,16 @@ class DNDarray:
         if halos is None:
             return self.larray
         from_prev, from_next = halos
+        phys = self.parray
         fn = _halo_concat_program(
             self.__comm.mesh,
             self.__comm.axis_name,
             self.__split,
-            tuple(int(s) for s in self.__array.shape),
+            tuple(int(s) for s in phys.shape),
             tuple(int(s) for s in from_prev.shape),
-            str(self.__array.dtype),
+            str(phys.dtype),
         )
-        return fn(from_prev, self.__array, from_next)
+        return fn(from_prev, phys, from_next)
 
     @property
     def halo_prev(self) -> Optional[jax.Array]:
@@ -460,9 +499,14 @@ class DNDarray:
     # conversions
     # ------------------------------------------------------------------
     def astype(self, dtype, copy: bool = True) -> "DNDarray":
-        """Cast to a new element type (reference dndarray.py:443-468)."""
+        """Cast to a new element type (reference dndarray.py:443-468). Casts
+        of a pending recorded chain stay recorded (``fusion.cast`` node)."""
         dtype = types.canonical_heat_type(dtype)
-        casted = self.__array.astype(dtype.jax_type())
+        arr = self.__array
+        if isinstance(arr, fusion.LazyArray):
+            casted = fusion.cast(arr, dtype.jax_type())
+        else:
+            casted = arr.astype(dtype.jax_type())
         if copy:
             return DNDarray(
                 casted, self.__gshape, dtype, self.__split, self.__device, self.__comm
@@ -646,7 +690,8 @@ class DNDarray:
         if self.padded:
             self.__array = _pad_and_place(new, self.__split, self.__comm)
         else:
-            self.__array = _ensure_split(new, self.__split, self.__comm)
+            # ``new`` is a freshly-computed temporary: donate it on reshard
+            self.__array = _reshard_donating(new, self.__split, self.__comm)
 
     def fill_diagonal(self, value) -> "DNDarray":
         """Fill the main diagonal in place (reference dndarray.py:608-650)."""
@@ -658,7 +703,7 @@ class DNDarray:
         if self.padded:
             self.__array = _pad_and_place(new, self.__split, self.__comm)
         else:
-            self.__array = _ensure_split(new, self.__split, self.__comm)
+            self.__array = _reshard_donating(new, self.__split, self.__comm)
         return self
 
     # ------------------------------------------------------------------
@@ -830,9 +875,12 @@ class DNDarray:
         the reference's only execution model, and ~all of the wall time of
         small ops on a remote TPU (one tunnel round-trip per op) — then
         collapses into one XLA program per pipeline.
+
+        FORCING POINT: a pending recorded chain materializes here, so the
+        enclosing trace sees a concrete (or tracer) leaf, never a LazyArray.
         """
         aux = (self.__gshape, self.__dtype, self.__split, self.__device, self.__comm)
-        return (self.__array,), aux
+        return (self.parray,), aux
 
     @classmethod
     def _tree_unflatten(cls, aux, children):
@@ -945,8 +993,34 @@ def _halo_concat_program(mesh, axis: str, split: int, pshape, hshape, dtype_name
 @functools.lru_cache(maxsize=None)
 def _pad_program(widths: Tuple[Tuple[int, int], ...], target) -> callable:
     """Cached compiled pad-with-out-sharding program (keyed on pad widths and
-    the target NamedSharding so repeated ragged wraps never retrace)."""
+    the target NamedSharding so repeated ragged wraps never retrace). The
+    input is never donated here: a pad's output is strictly larger than its
+    input, so XLA cannot reuse the buffer (donation would only warn)."""
     return jax.jit(lambda a: jnp.pad(jnp.asarray(a), widths), out_shardings=target)
+
+
+@functools.lru_cache(maxsize=None)
+def _donating_reshard_program(target) -> callable:
+    """Cached jitted identity-with-out-sharding that DONATES its input buffer.
+
+    Used by the in-place mutators (``resplit_`` of a previously-padded
+    payload, ``__setitem__`` repads) whose source array is a freshly-created
+    temporary no caller can hold: the reshard is same-shape, so XLA reuses
+    the donated buffer instead of keeping source and destination alive."""
+    return jax.jit(lambda a: a, out_shardings=target, donate_argnums=(0,))
+
+
+def _reshard_donating(array: jax.Array, split: Optional[int], comm: MeshCommunication) -> jax.Array:
+    """Place ``array`` under the ``split`` sharding, donating its buffer.
+    Only for freshly-computed temporaries (see ``_donating_reshard_program``);
+    tracers and ragged splits fall back to :func:`_ensure_split`."""
+    if (
+        isinstance(array, jax.core.Tracer)
+        or array.ndim == 0
+        or (split is not None and array.shape[split] % comm.size != 0)
+    ):
+        return _ensure_split(array, split, comm)
+    return _donating_reshard_program(comm.sharding(array.ndim, split))(array)
 
 
 def _pad_and_place(array: jax.Array, split: int, comm: MeshCommunication) -> jax.Array:

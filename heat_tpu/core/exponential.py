@@ -1,4 +1,9 @@
-"""Exponential and logarithmic functions (reference: heat/core/exponential.py)."""
+"""Exponential and logarithmic functions (reference: heat/core/exponential.py).
+
+Every function routes through the L3 engines with stable ``jnp`` callables,
+so under the eager fusion recorder (``core/fusion.py``) these ops defer into
+the surrounding chain and key stably into the sharded-program cache.
+"""
 
 from __future__ import annotations
 

@@ -1,0 +1,532 @@
+"""Eager op-chain fusion engine with a sharded-program cache.
+
+The reference HeAT design (and our port until this module) is eager op-by-op:
+every operator call dispatches its own XLA program, so a 10-op elementwise
+chain pays 10 dispatches and every reduction ends in its own device sync.
+This module makes the L3 engines (``core/_operations.py``) *deferred*: chains
+of elementwise / broadcast / cast / reduce ops are recorded as a small
+expression DAG (:class:`LazyArray` nodes stored as the ``DNDarray`` payload)
+instead of being executed, and the whole chain is materialized as ONE jitted,
+sharding-aware XLA program at a *forcing point*:
+
+* ``DNDarray.parray`` / ``.larray`` access (and everything built on them:
+  ``numpy()``, ``item()``, printing, I/O, indexing, collectives, linalg,
+  ``out=`` buffers, mixed eager fallbacks),
+* flattening for ``jax.jit`` pytree pipelines (``_tree_flatten``).
+
+Compiled programs live in an LRU keyed on (DAG structure — op identities,
+topology, static kwargs — leaf logical shapes, dtypes, shardings i.e. split
+axes + mesh), so steady-state loops hit compiled code with zero retraces.
+Reductions record lazily too: a chain of k reductions feeding one consumer
+costs ONE device sync at the forcing point instead of k.
+
+Correctness stance
+------------------
+* *Always-correct, transparently-forced*: any access to the physical payload
+  forces the chain; no user-visible API returns unmaterialized state.
+* The pad+mask ragged contract is preserved: identical-layout chains compute
+  on the *physical* (padded) payloads, so padding garbage stays in padding
+  through fused programs; reductions across the split axis record an explicit
+  un-pad slice so padding never enters the reduction (exactly the eager
+  engines' rule).
+* Anything the recorder cannot prove deferrable (``out=``/``where=`` buffers,
+  unhashable kwargs, tracer payloads under an enclosing ``jax.jit``, padded
+  broadcasts, shape-changing "local" ops) falls back to the eager engine
+  unchanged.
+
+``HEAT_TPU_FUSION=0`` is the escape hatch: it disables recording (the eager
+engines run exactly as before); forcing of already-recorded nodes keeps
+working regardless of the flag.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from collections import OrderedDict
+from contextlib import contextmanager
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "LazyArray",
+    "active",
+    "disabled",
+    "force",
+    "is_deferred",
+    "cache_stats",
+    "clear_cache",
+]
+
+_OFF_VALUES = ("0", "false", "off", "no")
+
+# recording beyond this chain depth force-materializes the sub-chain first:
+# unbounded deferral would otherwise grow the DAG (and the compiled program)
+# without limit in loops that never hit a forcing point
+_MAX_CHAIN = int(os.environ.get("HEAT_TPU_FUSION_MAX_CHAIN", "128"))
+_CACHE_SIZE = int(os.environ.get("HEAT_TPU_FUSION_CACHE", "512"))
+
+
+# the escape hatch is read ONCE at import (a per-op os.environ lookup is
+# measurable on the record hot path); in-process toggling goes through
+# set_enabled()/disabled(), cross-process through the env var
+_ENABLED = os.environ.get("HEAT_TPU_FUSION", "1").lower() not in _OFF_VALUES
+
+
+def active() -> bool:
+    """Whether the recorder is on (``HEAT_TPU_FUSION`` escape hatch, read at
+    import; see :func:`set_enabled`/:func:`disabled` for in-process control)."""
+    return _ENABLED
+
+
+def set_enabled(flag: bool) -> bool:
+    """Flip the recorder in-process; returns the previous state."""
+    global _ENABLED
+    prev, _ENABLED = _ENABLED, bool(flag)
+    return prev
+
+
+@contextmanager
+def disabled():
+    """Context manager running with fusion recording off (used by the parity
+    tests and the fused-vs-unfused benchmark legs)."""
+    prev = set_enabled(False)
+    try:
+        yield
+    finally:
+        set_enabled(prev)
+
+
+class LazyArray:
+    """One recorded expression-DAG node.
+
+    ``children`` entries are other ``LazyArray`` nodes, concrete arrays
+    (``jax.Array`` / ``np.ndarray``) or Python scalars; ``kw`` is the sorted
+    tuple of static keyword arguments baked into the program. ``shape`` /
+    ``dtype`` describe the *physical* result (inferred abstractly at record
+    time, never by executing the op).
+    """
+
+    __slots__ = ("fn", "children", "kw", "shape", "dtype", "depth", "_value")
+
+    def __init__(self, fn, children, kw, shape, dtype, depth):
+        self.fn = fn
+        self.children = children
+        self.kw = kw
+        self.shape = shape
+        self.dtype = dtype
+        self.depth = depth
+        self._value = None
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def astype(self, dtype) -> "LazyArray":
+        """Deferred cast — keeps ``DNDarray.astype`` chains recorded."""
+        return cast(self, dtype)
+
+    def __repr__(self) -> str:  # debugging aid only
+        state = "forced" if self._value is not None else f"depth={self.depth}"
+        return f"LazyArray({getattr(self.fn, '__name__', self.fn)}, shape={self.shape}, dtype={self.dtype}, {state})"
+
+
+# ----------------------------------------------------------------------
+# recording
+# ----------------------------------------------------------------------
+def _astype_op(x, *, dtype):
+    return jnp.asarray(x).astype(dtype)
+
+
+def _unpad_op(x, *, axis, size):
+    # the mask step of pad+mask: slice the suffix padding off the split dim
+    # INSIDE the fused program, so cross-split reductions never see padding
+    return jax.lax.slice_in_dim(x, 0, size, axis=axis)
+
+
+def _aval(c) -> Tuple[Tuple[int, ...], np.dtype]:
+    if isinstance(c, LazyArray):
+        return c.shape, c.dtype
+    if isinstance(c, (jax.Array, np.ndarray)):
+        return tuple(c.shape), np.dtype(c.dtype)
+    # python scalar: only ever feeds an _astype_op node, whose output aval
+    # does not depend on the input dtype
+    return (), np.result_type(type(c))
+
+
+@functools.lru_cache(maxsize=8192)
+def _infer_cached(fn, child_avals, kw):
+    """Abstract (shape, dtype) of ``fn(*children, **kw)`` via one cached
+    ``jax.eval_shape`` — the op is never executed at record time."""
+    args = [jax.ShapeDtypeStruct(s, d) for s, d in child_avals]
+    # kwargs are baked into the closure: eval_shape abstracts every argument
+    # it is passed, and ops like jnp.sum need keepdims/axis static
+    kw_d = dict(kw)
+    out = jax.eval_shape(lambda *a: fn(*a, **kw_d), *args)
+    return tuple(out.shape), np.dtype(out.dtype)
+
+
+def record(fn, children, **kw) -> LazyArray:
+    """Record ``fn(*children, **kw)`` as a DAG node without dispatching it.
+
+    ``kw`` values must be hashable (callers pre-check); shape/dtype are
+    inferred abstractly. Raises on inference failure — callers catch and fall
+    back to the eager engine, which reproduces the error eagerly.
+    """
+    kw_t = tuple(sorted(kw.items()))
+    depth = 1 + max(
+        (c.depth for c in children if isinstance(c, LazyArray) and c._value is None),
+        default=0,
+    )
+    if depth > _MAX_CHAIN:
+        children = tuple(
+            force(c) if isinstance(c, LazyArray) and c._value is None else c
+            for c in children
+        )
+        depth = 1
+    if fn is _astype_op:
+        shape = _aval(children[0])[0]
+        dtype = np.dtype(kw["dtype"])
+    elif fn is _unpad_op:
+        shape = list(_aval(children[0])[0])
+        shape[kw["axis"]] = kw["size"]
+        shape = tuple(shape)
+        dtype = _aval(children[0])[1]
+    else:
+        shape, dtype = _infer_cached(fn, tuple(_aval(c) for c in children), kw_t)
+    return LazyArray(fn, tuple(children), kw_t, shape, dtype, depth)
+
+
+def cast(c, jax_dtype) -> LazyArray:
+    """A deferred dtype cast node (no-op passthrough when already right)."""
+    dt = np.dtype(jax_dtype)
+    if isinstance(c, (LazyArray, jax.Array, np.ndarray)) and np.dtype(_aval(c)[1]) == dt:
+        return c
+    return record(_astype_op, (c,), dtype=dt.name)
+
+
+# ----------------------------------------------------------------------
+# the sharded-program cache + materialization
+# ----------------------------------------------------------------------
+_PROGRAMS: "OrderedDict[tuple, callable]" = OrderedDict()
+_STATS = {"compiles": 0, "hits": 0, "forces": 0}
+
+
+def _leaf_sig(v):
+    if isinstance(v, jax.Array):
+        # the sharding carries both the split axes and the mesh, so a layout
+        # or mesh-size change keys a fresh program (shardings and np dtypes
+        # are hashable; no string derivation on the hot path)
+        return ("L", v.shape, v.dtype, getattr(v, "sharding", None))
+    if isinstance(v, np.ndarray):
+        return ("L", v.shape, v.dtype, None)
+    return ("Ls", type(v))
+
+
+def _signature(root: LazyArray):
+    """Postorder structural signature + the leaf operands, DAG-deduplicated
+    (a shared subexpression appears once and is referenced by index)."""
+    entries = []
+    leaves = []
+    memo = {}
+    stack = [(root, False)]
+    while stack:
+        obj, expanded = stack.pop()
+        oid = id(obj)
+        if oid in memo:
+            continue
+        if not (isinstance(obj, LazyArray) and obj._value is None):
+            val = obj._value if isinstance(obj, LazyArray) else obj
+            memo[oid] = len(entries)
+            leaves.append(val)
+            entries.append(_leaf_sig(val))
+            continue
+        if not expanded:
+            stack.append((obj, True))
+            for c in obj.children:
+                stack.append((c, False))
+        else:
+            memo[oid] = len(entries)
+            entries.append((obj.fn, tuple(memo[id(c)] for c in obj.children), obj.kw))
+    return tuple(entries), leaves
+
+
+def _build(sig):
+    """The executable for a structural signature: replays the DAG from the
+    leaf operands. One instance per signature, jitted once — steady-state
+    calls with fresh same-shaped inputs reuse the compiled program."""
+
+    def run(*leaves):
+        vals = []
+        li = 0
+        for e in sig:
+            if e[0] == "L" or e[0] == "Ls":
+                vals.append(leaves[li])
+                li += 1
+            else:
+                fn, idxs, kw = e
+                vals.append(fn(*(vals[i] for i in idxs), **dict(kw)))
+        return vals[-1]
+
+    return run
+
+
+def force(node):
+    """Materialize a recorded DAG as one cached, jitted XLA program.
+
+    Under an active trace (an enclosing ``jax.jit``/``eval_shape``) the
+    program executes into that trace, so the result may be a tracer — it is
+    then returned WITHOUT being cached on the node (caching a tracer would
+    leak it past the trace's lifetime)."""
+    if not isinstance(node, LazyArray):
+        return node
+    if node._value is not None:
+        return node._value
+    sig, leaves = _signature(node)
+    prog = _PROGRAMS.get(sig)
+    if prog is None:
+        prog = jax.jit(_build(sig))
+        _PROGRAMS[sig] = prog
+        _STATS["compiles"] += 1
+        while len(_PROGRAMS) > _CACHE_SIZE:
+            _PROGRAMS.popitem(last=False)
+    else:
+        _PROGRAMS.move_to_end(sig)
+        _STATS["hits"] += 1
+    _STATS["forces"] += 1
+    value = prog(*leaves)
+    # under an enclosing trace the jit bind joins that trace and the value is
+    # a tracer even though every leaf is concrete (verified on jax 0.4.37);
+    # caching is gated on the value's actual concreteness, not ambient state
+    if not isinstance(value, jax.core.Tracer):
+        node._value = value
+        # drop the recorded graph: later forces of ancestors treat this node
+        # as a leaf, and the chain's operand buffers become collectable
+        node.children = ()
+    return value
+
+
+def is_deferred(x) -> bool:
+    """Whether a DNDarray currently carries an unmaterialized recorded chain."""
+    payload = getattr(x, "_payload", x)
+    return isinstance(payload, LazyArray) and payload._value is None
+
+
+def cache_stats() -> dict:
+    """Program-cache counters (``compiles`` is the retrace count the
+    compile-count tests pin)."""
+    return dict(_STATS, size=len(_PROGRAMS))
+
+
+def clear_cache() -> None:
+    _PROGRAMS.clear()
+    _STATS.update(compiles=0, hits=0, forces=0)
+
+
+# ----------------------------------------------------------------------
+# deferral front-ends for the L3 engines
+# ----------------------------------------------------------------------
+_SCALARS = (int, float, bool, complex, np.number, np.bool_)
+
+# sibling-module handles resolved on first use (function-level `from . import`
+# costs ~1µs of import machinery per call on the record hot path; a module
+# top-level import would be circular — dndarray imports fusion)
+DNDarray = None
+_types = None
+_broadcast_shape = None
+
+
+def _resolve_siblings():
+    global DNDarray, _types, _broadcast_shape
+    from . import types as types_mod
+    from .dndarray import DNDarray as dnd_cls
+    from .stride_tricks import broadcast_shape as bshape
+
+    DNDarray, _types, _broadcast_shape = dnd_cls, types_mod, bshape
+
+
+def hashable_kwargs(kw: dict) -> bool:
+    try:
+        hash(tuple(sorted(kw.items())))
+        return True
+    except TypeError:
+        return False
+
+
+def _phys_node(x):
+    """The DNDarray's physical payload as a recordable child, or None when it
+    is a tracer (inside an enclosing jit/vmap trace, where deferral would
+    nest programs — the enclosing trace already fuses)."""
+    arr = x._payload
+    if isinstance(arr, LazyArray):
+        return arr if arr._value is None else arr._value
+    if isinstance(arr, jax.core.Tracer):
+        return None
+    return arr
+
+
+def _logical_node(x):
+    n = _phys_node(x)
+    if n is not None and x.padded:
+        n = record(_unpad_op, (n,), axis=x.split, size=x.shape[x.split])
+    return n
+
+
+def _wrap(node, gshape, split, ref):
+    """Wrap a recorded node as a DNDarray. Direct slot assembly (the
+    ``_tree_unflatten`` pattern): the constructor's pad/validation logic is
+    metadata-only for a LazyArray payload, and this sits on the per-op path."""
+    if split is not None and (len(node.shape) == 0 or split >= len(gshape)):
+        split = None
+    obj = DNDarray.__new__(DNDarray)
+    obj._DNDarray__gshape = gshape
+    obj._DNDarray__dtype = _types.canonical_heat_type(node.dtype)
+    obj._DNDarray__split = split
+    obj._DNDarray__device = ref.device
+    obj._DNDarray__comm = ref.comm
+    obj._DNDarray__balanced = True
+    obj._DNDarray__array = node
+    return obj
+
+
+def defer_binary(operation, t1, t2, jt, fn_kwargs):
+    """Record a binary elementwise/broadcast op; None = use the eager engine.
+
+    Mirrors the eager engine's layout rules: identical-layout operands (or
+    array⊗scalar) chain on the *physical* payloads so ragged padding stays in
+    the padding; unpadded broadcasts follow split dominance. Padded operands
+    with mismatched shapes are left to the eager engine.
+    """
+    if DNDarray is None:
+        _resolve_siblings()
+    if getattr(operation, "_no_fusion", False):
+        # impure engine ops (closures reading other DNDarrays, e.g. where's
+        # cond-alignment op) must not be traced abstractly or cached
+        return None
+    d1, d2 = isinstance(t1, DNDarray), isinstance(t2, DNDarray)
+    ref = t1 if d1 else t2
+    if d1 and d2:
+        if t1.comm is not t2.comm:
+            return None
+        if t1.split == t2.split and t1.shape == t2.shape:
+            a, b = _phys_node(t1), _phys_node(t2)
+            if a is None or b is None:
+                return None
+            out_shape, out_split = t1.shape, t1.split
+            expected_phys = _aval(a)[0]
+        elif not t1.padded and not t2.padded:
+            a, b = _phys_node(t1), _phys_node(t2)
+            if a is None or b is None:
+                return None
+            # shape check stays eager-identical (error parity)
+            out_shape = _broadcast_shape(t1.shape, t2.shape)
+            expected_phys = out_shape
+
+            def _bcast_split(split, shape):
+                return None if split is None else split + (len(out_shape) - len(shape))
+
+            out_split = _bcast_split(t1.split, t1.shape)
+            if out_split is None:
+                out_split = _bcast_split(t2.split, t2.shape)
+        else:
+            return None
+    elif d1 and isinstance(t2, _SCALARS):
+        a, b = _phys_node(t1), t2
+        if a is None:
+            return None
+        out_shape, out_split = t1.shape, t1.split
+        expected_phys = _aval(a)[0]
+    elif d2 and isinstance(t1, _SCALARS):
+        a, b = t1, _phys_node(t2)
+        if b is None:
+            return None
+        out_shape, out_split = t2.shape, t2.split
+        expected_phys = _aval(b)[0]
+    else:
+        return None  # np.ndarray / list / foreign operands: eager engine
+    try:
+        node = record(operation, (cast(a, jt), cast(b, jt)), **fn_kwargs)
+    except Exception:  # noqa: BLE001 - op rejects the operands: eager raises it
+        return None
+    if node.shape != tuple(expected_phys):
+        return None  # not elementwise after all — eager path owns it
+    return _wrap(node, out_shape, out_split, ref)
+
+
+def defer_local(operation, x, promote_jt, kwargs):
+    """Record a unary elementwise op on the physical payload (padding garbage
+    stays in padding); None = use the eager engine."""
+    if DNDarray is None:
+        _resolve_siblings()
+    if getattr(operation, "_no_fusion", False) or not hashable_kwargs(kwargs):
+        return None
+    n = _phys_node(x)
+    if n is None:
+        return None
+    phys_shape = _aval(n)[0]
+    if promote_jt is not None:
+        n = cast(n, promote_jt)
+    try:
+        node = record(operation, (n,), **kwargs)
+    except Exception:  # noqa: BLE001
+        return None
+    if node.shape != phys_shape:
+        return None  # shape-changing op: the eager engine's rare branch
+    return _wrap(node, x.shape, x.split, x)
+
+
+def defer_reduce(partial_op, x, axis, keepdims, out_split, dtype, kwargs):
+    """Record a reduction; the chain (including the reduction) then costs one
+    program + one sync at the forcing point. None = use the eager engine."""
+    if DNDarray is None:
+        _resolve_siblings()
+    if getattr(partial_op, "_no_fusion", False) or not hashable_kwargs(kwargs):
+        return None
+    axes = None if axis is None else ((axis,) if isinstance(axis, int) else tuple(axis))
+    padded_fast = x.padded and axes is not None and x.split not in axes
+    child = _phys_node(x) if (padded_fast or not x.padded) else _logical_node(x)
+    if child is None:
+        return None
+    ax_kw = axis if (axis is None or isinstance(axis, int)) else tuple(axis)
+    try:
+        node = record(partial_op, (child,), axis=ax_kw, keepdims=keepdims, **kwargs)
+        if dtype is not None:
+            node = cast(node, _types.canonical_heat_type(dtype).jax_type())
+    except Exception:  # noqa: BLE001
+        return None
+    if padded_fast:
+        gshape = list(x.shape)
+        for a in sorted(axes, reverse=True):
+            if keepdims:
+                gshape[a] = 1
+            else:
+                del gshape[a]
+        gshape = tuple(gshape)
+    else:
+        gshape = node.shape
+    return _wrap(node, gshape, out_split, x)
+
+
+def defer_cum(operation, x, axis, dtype):
+    """Record a cumulative op (padding is a suffix, so any-axis scans leave
+    the data region untouched); None = use the eager engine."""
+    if DNDarray is None:
+        _resolve_siblings()
+    if getattr(operation, "_no_fusion", False):
+        return None
+    n = _phys_node(x)
+    if n is None:
+        return None
+    phys_shape = _aval(n)[0]
+    try:
+        node = record(operation, (n,), axis=axis)
+        if dtype is not None:
+            node = cast(node, _types.canonical_heat_type(dtype).jax_type())
+    except Exception:  # noqa: BLE001
+        return None
+    if node.shape != phys_shape:
+        return None
+    return _wrap(node, x.shape, x.split, x)

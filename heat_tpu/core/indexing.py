@@ -75,4 +75,7 @@ def where(cond: DNDarray, x=None, y=None) -> DNDarray:
                 c = jnp.pad(c, widths)
         return jnp.where(c.astype(bool), a, b)
 
+    # the closure reads cond/x_ref state: it must run in the eager engine,
+    # never be abstractly traced or cached by the fusion recorder
+    op._no_fusion = True
     return _binary_op(op, x, y)

@@ -55,9 +55,11 @@ def any(x, axis=None, out=None, keepdims=False, keepdim=None) -> DNDarray:
 
 
 def isclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> DNDarray:
-    """Elementwise closeness (reference logical.py:212)."""
+    """Elementwise closeness (reference logical.py:212). The tolerances ride
+    as static ``fn_kwargs`` (a per-call lambda would defeat the fusion
+    engine's program cache)."""
     res = _binary_op(
-        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y
+        jnp.isclose, x, y, fn_kwargs=dict(rtol=rtol, atol=atol, equal_nan=equal_nan)
     )
     return res.astype(types.bool, copy=False) if res.dtype is not types.bool else res
 

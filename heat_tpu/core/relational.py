@@ -1,4 +1,9 @@
-"""Elementwise comparison operations (reference: heat/core/relational.py:35-420)."""
+"""Elementwise comparison operations (reference: heat/core/relational.py:35-420).
+
+Comparisons defer under the eager fusion recorder like any other binary op;
+the trailing bool cast in ``_cmp`` records as a fusion cast node, so an
+``(a < b).astype(bool)`` chain stays a single program at the forcing point.
+"""
 
 from __future__ import annotations
 

@@ -36,12 +36,14 @@ def ceil(x, out=None) -> DNDarray:
 
 
 def clip(x, min=None, max=None, out=None) -> DNDarray:
-    """Clip values to [min, max] (reference rounding.py:118)."""
+    """Clip values to [min, max] (reference rounding.py:118). Scalar bounds
+    ride as static kwargs (cacheable under the fusion engine); array bounds
+    make the kwargs unhashable, which routes to the eager engine unchanged."""
     if min is None and max is None:
         raise ValueError("either min or max must be set")
     lo = min.larray if isinstance(min, DNDarray) else min
     hi = max.larray if isinstance(max, DNDarray) else max
-    return _local_op(lambda a: jnp.clip(a, lo, hi), x, out=out, no_cast=True)
+    return _local_op(jnp.clip, x, out=out, no_cast=True, min=lo, max=hi)
 
 
 def floor(x, out=None) -> DNDarray:
@@ -55,8 +57,8 @@ def modf(x, out=None):
 
     if not isinstance(x, D):
         raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
-    frac = _local_op(lambda a: jnp.modf(a)[0], x)
-    whole = _local_op(lambda a: jnp.modf(a)[1], x)
+    frac = _local_op(_modf_frac, x)
+    whole = _local_op(_modf_whole, x)
     if out is not None:
         if not isinstance(out, tuple) or len(out) != 2:
             raise TypeError(f"expected out to be None or a tuple of two DNDarrays, but was {type(out)}")
@@ -66,9 +68,17 @@ def modf(x, out=None):
     return (frac, whole)
 
 
+def _modf_frac(a):
+    return jnp.modf(a)[0]
+
+
+def _modf_whole(a):
+    return jnp.modf(a)[1]
+
+
 def round(x, decimals: int = 0, out=None, dtype=None) -> DNDarray:
     """Round to given decimals (reference rounding.py:220)."""
-    res = _local_op(lambda a: jnp.round(a, decimals=decimals), x, out=out)
+    res = _local_op(jnp.round, x, out=out, decimals=decimals)
     if dtype is not None and out is None:
         res = res.astype(dtype)
     return res
@@ -79,10 +89,14 @@ def sgn(x, out=None) -> DNDarray:
     return _local_op(jnp.sign, x, out=out, no_cast=True)
 
 
+def _sign_of_real(a):
+    return jnp.sign(a.real).astype(a.dtype)
+
+
 def sign(x, out=None) -> DNDarray:
     """Sign of elements; for complex, sign of the real part (reference rounding.py:290)."""
     if types.heat_type_is_complexfloating(x.dtype):
-        return _local_op(lambda a: jnp.sign(a.real).astype(a.dtype), x, out=out, no_cast=True)
+        return _local_op(_sign_of_real, x, out=out, no_cast=True)
     return _local_op(jnp.sign, x, out=out, no_cast=True)
 
 

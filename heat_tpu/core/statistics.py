@@ -11,6 +11,7 @@ these widths, so the merge choreography is not re-implemented.
 from __future__ import annotations
 
 import builtins
+import functools
 from typing import Optional, Sequence, Tuple, Union
 
 import jax
@@ -388,6 +389,7 @@ def _moment_stat(x, axis, order, unbiased, fischer=True):
     return _wrap(jnp.asarray(g), _reduced_split(x, axis), x)
 
 
+@functools.lru_cache(maxsize=None)
 def _nan_propagating(op):
     """numpy max/min semantics: any NaN in the reduced window wins.
 
@@ -397,6 +399,10 @@ def _nan_propagating(op):
     on the mesh size. One explicit isnan any-reduction restores the numpy
     contract deterministically; the pad-aware fast path stays safe because
     pad-slot NaNs only ever land in pad slots of the result.
+
+    The wrapper is cached per ``op`` so its identity is stable call-to-call —
+    the fusion engine's program cache keys on the operation object, and a
+    fresh closure per ``ht.max`` call would force a retrace every time.
     """
 
     def fn(src, axis=None, keepdims=False, **kw):
@@ -437,14 +443,11 @@ def maximum(x1: DNDarray, x2: DNDarray, out=None) -> DNDarray:
 def mean(x: DNDarray, axis=None, keepdims: bool = False) -> DNDarray:
     """Arithmetic mean (reference statistics.py:941-1007: local torch.mean +
     Allreduce of (mu, n) pairs with sequential merging; one sharded jnp.mean
-    here)."""
-    sanitation.sanitize_in(x)
-    axis = sanitize_axis(x.shape, axis)
-    data = x.larray
-    if types.heat_type_is_exact(x.dtype):
-        data = data.astype(types.promote_types(x.dtype, types.float32).jax_type())
-    result = jnp.mean(data, axis=axis, keepdims=keepdims)
-    return _wrap(result, _reduced_split(x, axis, keepdims), x)
+    here). Routes through the L3 reduce engine, so under the fusion recorder
+    a mean at the end of an op chain stays in the chain's single program."""
+    if types.heat_type_is_exact(getattr(x, "dtype", types.float32)):
+        x = x.astype(types.promote_types(x.dtype, types.float32))
+    return _reduce_op(jnp.mean, x, axis, keepdims=keepdims)
 
 
 def median(x: DNDarray, axis: Optional[int] = None, keepdims: bool = False, keepdim=None) -> DNDarray:
@@ -567,11 +570,10 @@ def percentile(
 
 
 def std(x: DNDarray, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
-    """Standard deviation (reference statistics.py:1936-1996)."""
+    """Standard deviation (reference statistics.py:1936-1996). The sqrt goes
+    through the L3 local engine so var+sqrt stay one recorded chain."""
     v = var(x, axis, ddof=ddof, **kwargs)
-    import jax.numpy as _jnp
-
-    return _wrap(_jnp.sqrt(v.larray), v.split, v)
+    return _local_op(jnp.sqrt, v, no_cast=True)
 
 
 def var(x: DNDarray, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
@@ -585,12 +587,9 @@ def var(x: DNDarray, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
     if kwargs.get("bessel") is not None:
         ddof = 1 if kwargs["bessel"] else 0
     keepdims = bool(kwargs.get("keepdims", False))
-    axis = sanitize_axis(x.shape, axis)
-    data = x.larray
     if types.heat_type_is_exact(x.dtype):
-        data = data.astype(types.promote_types(x.dtype, types.float32).jax_type())
-    result = jnp.var(data, axis=axis, ddof=ddof, keepdims=keepdims)
-    return _wrap(jnp.asarray(result), _reduced_split(x, axis, keepdims), x)
+        x = x.astype(types.promote_types(x.dtype, types.float32))
+    return _reduce_op(jnp.var, x, axis, keepdims=keepdims, ddof=ddof)
 
 
 def mpi_argmax(a, b):

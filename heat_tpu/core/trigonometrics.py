@@ -1,4 +1,10 @@
-"""Trigonometric and hyperbolic functions (reference: heat/core/trigonometrics.py)."""
+"""Trigonometric and hyperbolic functions (reference: heat/core/trigonometrics.py).
+
+Every function routes through the L3 engines with stable ``jnp`` callables,
+so under the eager fusion recorder (``core/fusion.py``) these ops defer into
+the surrounding chain and key stably into the sharded-program cache (the
+``_f`` integer-promotion pre-cast records as a fusion cast node).
+"""
 
 from __future__ import annotations
 

@@ -17,6 +17,7 @@ the CPU mesh).
 from __future__ import annotations
 
 import builtins
+import functools
 from typing import Any, Union
 
 import jax.numpy as jnp
@@ -269,7 +270,23 @@ _PY_TO_TYPE = {
 
 def canonical_heat_type(a_type) -> type:
     """Map any dtype-like (heat type, str, numpy/jax dtype, python type) to the
-    canonical heat_tpu type class (reference heat/core/types.py:495)."""
+    canonical heat_tpu type class (reference heat/core/types.py:495).
+
+    Memoized for hashable inputs: this sits on the per-op hot path of the
+    eager engines AND the fusion recorder, and the ``np.dtype(...).name``
+    string derivation dominates its cost."""
+    try:
+        return _canonical_heat_type_cached(a_type)
+    except TypeError:  # unhashable dtype-like: fall through uncached
+        return _canonical_heat_type(a_type)
+
+
+@functools.lru_cache(maxsize=None)
+def _canonical_heat_type_cached(a_type) -> type:
+    return _canonical_heat_type(a_type)
+
+
+def _canonical_heat_type(a_type) -> type:
     if isinstance(a_type, type) and issubclass(a_type, datatype):
         if a_type._jax_dtype is None:
             raise TypeError(f"data type {a_type} is abstract")
@@ -391,9 +408,13 @@ def promote_types(type1, type2) -> type:
     under the "intuitive" rule (reference heat/core/types.py:755-761, 836).
 
     float16/bfloat16 (TPU extensions; absent from the reference's table)
-    delegate to jax's promotion, which handles them natively."""
-    h1 = canonical_heat_type(type1)
-    h2 = canonical_heat_type(type2)
+    delegate to jax's promotion, which handles them natively. Memoized on
+    the canonical pair (pure scan over a fixed table, hot-path cost)."""
+    return _promote_types_cached(canonical_heat_type(type1), canonical_heat_type(type2))
+
+
+@functools.lru_cache(maxsize=None)
+def _promote_types_cached(h1, h2) -> type:
     if float16 in (h1, h2) or bfloat16 in (h1, h2):
         return canonical_heat_type(jnp.promote_types(h1.jax_type(), h2.jax_type()))
     t1 = np.dtype(h1.char())
@@ -405,6 +426,33 @@ def promote_types(type1, type2) -> type:
     raise TypeError(f"no promotion for {type1}, {type2}")
 
 
+def _result_kind(op):
+    """Hashable promotion key of one operand, or None when unclassifiable:
+    the heat dtype class for arrays, a weak-scalar kind marker for Python /
+    numpy scalars. Powers the memoized fast path of :func:`result_type`."""
+    if isinstance(op, type) and issubclass(op, datatype):
+        return op
+    if hasattr(op, "split") and hasattr(op, "dtype"):
+        return canonical_heat_type(op.dtype)
+    if isinstance(op, (builtins.bool, np.bool_)):
+        return "bool"
+    if isinstance(op, (builtins.int, np.integer)):
+        return "int"
+    if isinstance(op, (builtins.float, np.floating)):
+        return "float"
+    if isinstance(op, (builtins.complex, np.complexfloating)):
+        return "complex"
+    return None
+
+
+_KIND_SUBSTITUTES = {"bool": True, "int": 1, "float": 1.0, "complex": 1j}
+
+
+@functools.lru_cache(maxsize=None)
+def _result_type_keyed(keys) -> type:
+    return _result_type_impl(*(_KIND_SUBSTITUTES.get(k, k) for k in keys))
+
+
 def result_type(*operands) -> type:
     """Result type over arrays and scalars (reference types.py:868).
 
@@ -412,7 +460,18 @@ def result_type(*operands) -> type:
     JAX's x64 mode: a Python float joined with integer arrays promotes to the
     default float (float32), with float arrays it adopts their dtype; a Python
     int never widens a narrower integer array; a Python bool is neutral.
+
+    Memoized on the operands' promotion keys (dtype classes + weak-scalar
+    kinds — promotion never depends on values): this runs once per engine
+    call, and the promotion scan dominates small-op dispatch otherwise.
     """
+    keys = tuple(_result_kind(op) for op in operands)
+    if None not in keys:
+        return _result_type_keyed(keys)
+    return _result_type_impl(*operands)
+
+
+def _result_type_impl(*operands) -> type:
     dtypes: list = []
     scalar_kinds: list = []
     for op in operands:

@@ -4,7 +4,9 @@
 # (reference Jenkinsfile:24-28; SURVEY.md §4) — with per-leg line coverage
 # (the reference's codecov flags per world size, codecov.yml:1-20;
 # Jenkinsfile:36-39) collected by scripts/heat_coverage.py and merged into
-# one report at the end.
+# one report at the end, plus a fusion-off leg that reruns the elementwise
+# and eager-chain suites with HEAT_TPU_FUSION=0 so the deferred AND the
+# eager engine paths both stay green.
 set -e
 cd "$(dirname "$0")/.."
 COV_DIR=${HEAT_TPU_COV_DIR:-/tmp/heat_cov}
@@ -17,7 +19,29 @@ for size in ${@:-1 3 5 8}; do
     python -m pytest tests/ -q -x
   legs+=("$COV_DIR/cov_mesh$size.json")
 done
+# fusion leg: the eager engines (HEAT_TPU_FUSION=0 escape hatch) must match
+# the recorded/fused default on the suites that exercise op chains
+echo "=== fusion off (HEAT_TPU_FUSION=0) ==="
+HEAT_TPU_FUSION=0 \
+  python -m pytest tests/test_elementwise.py tests/test_eager_chain.py -q -x
 # the coverage gate (reference codecov.yml target semantics): the merged
-# matrix coverage must clear the floor or the matrix run fails
-python scripts/heat_coverage.py merge "$COV_DIR/coverage_merged.json" \
-  --fail-under "${HEAT_TPU_COV_MIN:-60}" "${legs[@]}"
+# matrix coverage must clear the floor or the matrix run fails. On runtimes
+# without sys.monitoring (Python < 3.12) no cov_mesh*.json legs are produced
+# — a green matrix must not then die on a FileNotFoundError, so the merge
+# only runs over legs that actually exist and is skipped when there are none.
+produced=()
+for leg in "${legs[@]}"; do
+  [ -f "$leg" ] && produced+=("$leg")
+done
+if [ "${#produced[@]}" -eq 0 ]; then
+  if python -c 'import sys; sys.exit(0 if sys.version_info >= (3, 12) else 1)'; then
+    # sys.monitoring IS available here — zero legs means the coverage
+    # pipeline itself broke (e.g. the atexit dump failing); fail loudly
+    echo "ERROR: no coverage legs produced although Python >= 3.12 supports sys.monitoring" >&2
+    exit 1
+  fi
+  echo "coverage: no cov_mesh*.json legs produced (sys.monitoring needs Python >= 3.12); skipping merge/gate"
+else
+  python scripts/heat_coverage.py merge "$COV_DIR/coverage_merged.json" \
+    --fail-under "${HEAT_TPU_COV_MIN:-60}" "${produced[@]}"
+fi

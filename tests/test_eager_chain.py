@@ -1,4 +1,5 @@
-"""Layout stability across eager op chains + the explicit-fallback warnings.
+"""Layout stability across eager op chains + the explicit-fallback warnings
++ the eager fusion engine's program-cache and forcing-point contracts.
 
 VERDICT weak-8: single ops are HLO-tested, but layout ping-pong BETWEEN
 chained eager ops (a device_put reshard per op) would pass every per-op
@@ -6,12 +7,21 @@ test. Here a representative 10-op pipeline on a split-0 operand must issue
 ZERO reshard device_puts after the initial placement — every intermediate
 stays on the split it entered with.
 
+The fusion tests pin the core/fusion.py contract: a steady-state chain
+structure compiles exactly once (zero retraces across repeated calls with
+fresh same-shape/split inputs), ragged chains match the unfused engines
+numerically with padding kept in padding, and every forcing point
+(print / indexing / I/O / collective) transparently materializes.
+
 Also pins the shared explicit-fallback policy (sanitation.warn_replicated):
 complex split-axis sort/unique announce their gathered execution instead of
 silently degrading (the qr.py:106-113 pattern, now one helper + one warning
 class).
 """
 
+import os
+import tempfile
+import unittest
 import unittest.mock
 import warnings
 
@@ -19,6 +29,7 @@ import numpy as np
 import pytest
 
 import heat_tpu as ht
+from heat_tpu.core import fusion
 from heat_tpu.core.sanitation import ReplicationWarning
 
 from harness import TestCase
@@ -73,6 +84,140 @@ class TestEagerChainLayout(TestCase):
         expect = np.exp((a_np + b_np) * 2.0) - b_np
         np.testing.assert_allclose(c.numpy(), expect, rtol=1e-6)
         self.assertEqual(c.split, 0)
+
+
+def _ten_op_chain(a, b):
+    """The representative 10-op pipeline (9 elementwise + 1 reduction)."""
+    c = (a + b) * 2.0
+    c = ht.exp(c)
+    c = c - b
+    d = ht.abs(c)
+    e = d + a
+    f = ht.sqrt(ht.abs(e))
+    g = f / (d + 1.0)
+    h = g * b
+    return ht.sum(h)
+
+
+def _ten_op_chain_np(a, b):
+    c = np.exp((a + b) * 2.0) - b
+    d = np.abs(c)
+    e = d + a
+    f = np.sqrt(np.abs(e))
+    g = f / (d + 1.0)
+    return (g * b).sum()
+
+
+@unittest.skipUnless(fusion.active(), "fusion disabled via HEAT_TPU_FUSION")
+class TestFusionCache(TestCase):
+    def _inputs(self, n, seed):
+        a = ht.array(
+            np.random.default_rng(seed).standard_normal((n, 4)).astype(np.float32), split=0
+        )
+        b = ht.array(
+            np.random.default_rng(seed + 100).standard_normal((n, 4)).astype(np.float32),
+            split=0,
+        )
+        return a, b
+
+    def test_ten_op_chain_compiles_once(self):
+        # the compile-count pin: the 10-op chain traces exactly once; every
+        # repeat with FRESH inputs of the same shape/split is a cache hit
+        n = 8 * self.get_size()
+        a, b = self._inputs(n, 0)
+        total = _ten_op_chain(a, b)
+        self.assertTrue(fusion.is_deferred(total))
+        float(total.larray)  # warm: may compile
+        compiles = fusion.cache_stats()["compiles"]
+        for seed in range(1, 4):
+            a, b = self._inputs(n, seed)
+            got = float(_ten_op_chain(a, b).larray)
+            np.testing.assert_allclose(
+                got, _ten_op_chain_np(a.numpy(), b.numpy()), rtol=1e-4
+            )
+        self.assertEqual(
+            fusion.cache_stats()["compiles"],
+            compiles,
+            "steady-state chain retraced: the sharded-program cache missed",
+        )
+
+    def test_ragged_chain_matches_unfused(self):
+        # ragged split axis: fused numeric parity with the eager engines
+        # (HEAT_TPU_FUSION=0), padding garbage stays in the padding
+        p = self.get_size()
+        n = 4 * p + (3 if p > 1 else 1)  # not divisible by p for p > 1
+        a_np = np.random.default_rng(7).standard_normal((n, 5)).astype(np.float32)
+        b_np = np.random.default_rng(8).standard_normal((n, 5)).astype(np.float32)
+
+        def chain(a, b):
+            c = ht.exp((a + b) * 0.5) - b
+            d = ht.sqrt(ht.abs(c)) + 1.0
+            return d, ht.sum(d, axis=0), ht.sum(d, axis=1)
+
+        a, b = ht.array(a_np, split=0), ht.array(b_np, split=0)
+        d_f, cross_f, keep_f = chain(a, b)
+        self.assertTrue(fusion.is_deferred(d_f))
+        block = -(-n // p)
+        # padding preserved: the physical payload keeps the p*ceil(n/p) rows
+        self.assertEqual(d_f.parray.shape, (block * p, 5))
+        with fusion.disabled():
+            a0, b0 = ht.array(a_np, split=0), ht.array(b_np, split=0)
+            d_e, cross_e, keep_e = chain(a0, b0)
+            self.assertFalse(fusion.is_deferred(d_e))
+        np.testing.assert_allclose(d_f.numpy(), d_e.numpy(), rtol=1e-6)
+        np.testing.assert_allclose(cross_f.numpy(), cross_e.numpy(), rtol=1e-5)
+        np.testing.assert_allclose(keep_f.numpy(), keep_e.numpy(), rtol=1e-5)
+        self.assertEqual(keep_f.split, keep_e.split)
+
+    def test_forcing_points_flush(self):
+        # every forcing point must transparently materialize the chain:
+        # print, indexing, I/O, collective (resplit_ redistribution)
+        n = 4 * self.get_size()
+        a_np = np.random.default_rng(9).standard_normal((n, 3)).astype(np.float32)
+        expect = np.exp(a_np * 0.25) + 1.0
+
+        def chain():
+            return ht.exp(ht.array(a_np, split=0) * 0.25) + 1.0
+
+        # print/repr
+        x = chain()
+        self.assertTrue(fusion.is_deferred(x))
+        self.assertIn("DNDarray", str(x))
+        self.assertFalse(fusion.is_deferred(x))
+        np.testing.assert_allclose(x.numpy(), expect, rtol=1e-5)
+
+        # indexing
+        x = chain()
+        row = x[1]
+        self.assertFalse(fusion.is_deferred(x))
+        np.testing.assert_allclose(row.numpy(), expect[1], rtol=1e-5)
+
+        # I/O
+        x = chain()
+        with tempfile.TemporaryDirectory() as tmp:
+            path = os.path.join(tmp, "chain.npy")
+            ht.save_npy(x, path)
+            self.assertFalse(fusion.is_deferred(x))
+            np.testing.assert_allclose(np.load(path), expect, rtol=1e-5)
+
+        # collective: resplit_ to a new distribution
+        x = chain()
+        x.resplit_(1) if x.shape[1] >= 1 else x.resplit_(None)
+        self.assertFalse(fusion.is_deferred(x))
+        np.testing.assert_allclose(x.numpy(), expect, rtol=1e-5)
+
+    def test_k_reductions_one_chain(self):
+        # a chain mixing k reductions stays deferred until ONE forcing point
+        n = 8 * self.get_size()
+        a_np = np.random.default_rng(11).standard_normal((n,)).astype(np.float32)
+        a = ht.array(a_np, split=0)
+        combo = ht.mean(a) + ht.std(a) + ht.sum(a * a)
+        self.assertTrue(fusion.is_deferred(combo))
+        np.testing.assert_allclose(
+            float(combo.larray),
+            a_np.mean() + a_np.std() + (a_np * a_np).sum(),
+            rtol=1e-4,
+        )
 
 
 class TestReplicationWarnings(TestCase):

@@ -30,6 +30,7 @@ from .core import (
     signal,
     statistics,
     stride_tricks,
+    telemetry,
     tiling,
     trigonometrics,
     types,

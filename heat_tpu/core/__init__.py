@@ -16,6 +16,7 @@ from .version import __version__
 from .constants import *
 from .base import *
 from .stride_tricks import *
+from . import telemetry
 from . import fusion
 from .dndarray import *
 from .factories import *

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import devices, fusion, sanitation, types
+from . import devices, fusion, sanitation, telemetry, types
 from .communication import sanitize_comm
 from .dndarray import DNDarray, _ensure_split
 from .stride_tricks import broadcast_shape, sanitize_axis
@@ -73,7 +73,11 @@ def __binary_op(
     if out is None and where is None and fusion.active() and fusion.hashable_kwargs(fn_kwargs):
         lazy = fusion.defer_binary(operation, t1, t2, jt, fn_kwargs)
         if lazy is not None:
+            if telemetry._MODE:
+                telemetry.record_dispatch("binary", fused=True)
             return lazy
+    if telemetry._MODE:
+        telemetry.record_dispatch("binary", fused=False)
 
     # pad-aware fast path: identical-layout ragged operands (or ragged⊗scalar)
     # compute directly on the physical payloads — the padding suffix computes
@@ -169,7 +173,11 @@ def __local_op(
             promote = types.promote_types(x.dtype, types.float32).jax_type()
         lazy = fusion.defer_local(operation, x, promote, kwargs)
         if lazy is not None:
+            if telemetry._MODE:
+                telemetry.record_dispatch("local", fused=True)
             return lazy
+    if telemetry._MODE:
+        telemetry.record_dispatch("local", fused=False)
     padded = x.padded
     # pad-aware fast path: elementwise on the physical payload; the padding
     # suffix computes garbage that stays in the padding (SURVEY.md §7)
@@ -252,7 +260,11 @@ def __reduce_op(
     if out is None and fusion.active():
         lazy = fusion.defer_reduce(partial_op, x, axis, keepdims, out_split, dtype, kwargs)
         if lazy is not None:
+            if telemetry._MODE:
+                telemetry.record_dispatch("reduce", fused=True)
             return lazy
+    if telemetry._MODE:
+        telemetry.record_dispatch("reduce", fused=False)
 
     # pad-aware fast path: reducing only non-split axes of a ragged array —
     # the padding suffix reduces into the (shifted) padding suffix of the
@@ -318,7 +330,11 @@ def __cum_op(
     if out is None and fusion.active():
         lazy = fusion.defer_cum(operation, x, axis, dtype)
         if lazy is not None:
+            if telemetry._MODE:
+                telemetry.record_dispatch("cum", fused=True)
             return lazy
+    if telemetry._MODE:
+        telemetry.record_dispatch("cum", fused=False)
     # pad-aware fast path: the padding is a *suffix* of the global split dim,
     # so a cumulative op along ANY axis leaves the data region untouched —
     # along the split axis the garbage only accumulates past position n,

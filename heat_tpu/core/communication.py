@@ -38,6 +38,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from . import telemetry
+
 # NOTE: the lazy singletons (MESH_WORLD/MPI_WORLD/...) are deliberately NOT in
 # __all__ — a star import would force backend initialization at import time.
 # They are reachable as module attributes (heat_tpu.MPI_WORLD works via the
@@ -111,6 +113,7 @@ def _combine(op: Union[str, Callable]) -> Callable:
 
 def allreduce(x, axis: str, op: Union[str, Callable] = "sum", size: Optional[int] = None):
     """All-reduce ``x`` over mesh axis ``axis`` (reference Allreduce)."""
+    telemetry.record_collective_operand("allreduce", axis, x)
     if op == "sum":
         return jax.tree.map(lambda l: jax.lax.psum(l, axis), x)
     if op == "mean":
@@ -143,12 +146,14 @@ def allgather(x, axis: str, gather_axis: int = 0, tiled: bool = False):
     """All-gather over the mesh axis (reference Allgather(v)).
     ``tiled=False`` stacks a new axis at position ``gather_axis``;
     ``tiled=True`` concatenates along it."""
+    telemetry.record_collective_operand("allgather", axis, x)
     return jax.tree.map(lambda l: jax.lax.all_gather(l, axis, axis=gather_axis, tiled=tiled), x)
 
 
 def alltoall(x, axis: str, split_axis: int = 0, concat_axis: int = 0):
     """All-to-all over the mesh axis (reference Alltoall(v/w)): scatter
     ``split_axis``, concatenate received pieces along ``concat_axis``."""
+    telemetry.record_collective_operand("alltoall", axis, x)
     return jax.tree.map(
         lambda l: jax.lax.all_to_all(l, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True),
         x,
@@ -164,6 +169,7 @@ def ppermute(
 ):
     """Ring rotation: device ``d`` receives device ``(d + shift) % size``'s
     value; an explicit ``perm`` of (src, dst) pairs overrides ``shift``."""
+    telemetry.record_collective_operand("ppermute", axis, x)
     if perm is None:
         perm = [(j, (j - shift) % size) for j in range(size)]
     return jax.tree.map(lambda l: jax.lax.ppermute(l, axis, perm), x)
@@ -172,6 +178,7 @@ def ppermute(
 def bcast(x, axis: str, root: int = 0):
     """Every device gets ``root``'s value — a masked psum: O(1) memory, no
     gather (reference Bcast, communication.py:544-600)."""
+    telemetry.record_collective_operand("bcast", axis, x)
     idx = jax.lax.axis_index(axis)
 
     def pick(l):
@@ -187,6 +194,11 @@ def exscan(x, axis: str, size: int, op: Union[str, Callable] = "sum", neutral=No
     """Exclusive prefix combine over the device axis (reference Exscan,
     the cumsum/cumprod workhorse _operations.py:268-295). Device 0 gets the
     neutral element."""
+    telemetry.record_collective_operand("exscan", axis, x)
+    return _exscan_impl(x, axis, size, op, neutral)
+
+
+def _exscan_impl(x, axis: str, size: int, op: Union[str, Callable], neutral):
     idx = jax.lax.axis_index(axis)
     if neutral is None:
         if callable(op):
@@ -209,7 +221,8 @@ def exscan(x, axis: str, size: int, op: Union[str, Callable] = "sum", neutral=No
 
 def pscan(x, axis: str, size: int, op: Union[str, Callable] = "sum", neutral=None):
     """Inclusive prefix combine over the device axis (reference Scan)."""
-    return _combine(op)(exscan(x, axis, size, op, neutral), x)
+    telemetry.record_collective_operand("scan", axis, x)
+    return _combine(op)(_exscan_impl(x, axis, size, op, neutral), x)
 
 
 class Communication:
@@ -409,6 +422,10 @@ class MeshCommunication(Communication):
             out_specs = tuple(prefix_spec(s) for s in out_splits)
         else:
             out_specs = prefix_spec(out_splits)
+        if telemetry._MODE:
+            # each apply() builds (and traces) a fresh jit program — the
+            # retrace ledger keys them by kernel so repeat offenders show up
+            telemetry.record_compile("apply:" + getattr(kernel, "__name__", "kernel"))
         fn = jax.jit(
             jax.shard_map(
                 kernel,

@@ -44,11 +44,18 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import communication as comm_module
-from . import devices, fusion, types
+from . import devices, fusion, telemetry, types
 from .communication import Communication, MeshCommunication
 from .stride_tricks import sanitize_axis
 
 __all__ = ["DNDarray"]
+
+# forcing-point attribution scopes (telemetry): pushed only when a recorded
+# chain is actually pending, so the non-lazy hot paths pay one isinstance
+_T_LARRAY = telemetry.force_trigger("larray")
+_T_INDEXING = telemetry.force_trigger("indexing")
+_T_PYTREE = telemetry.force_trigger("pytree")
+_T_COLLECTIVE = telemetry.force_trigger("collective")
 
 Scalar = Union[int, float, bool, complex]
 
@@ -220,12 +227,21 @@ class DNDarray:
             self.__array = arr
         return arr
 
+    def _force_payload(self, scope) -> jax.Array:
+        """:attr:`parray` with the forcing point attributed to ``scope`` when
+        a recorded chain is pending (telemetry forcing-point attribution; the
+        outermost scope wins, so e.g. print-over-larray reads as print)."""
+        if isinstance(self.__array, fusion.LazyArray):
+            with scope:
+                return self.parray
+        return self.parray
+
     @property
     def larray(self) -> jax.Array:
         """The **logical** global ``jax.Array`` (see module docstring): the
         physical payload with any split-axis suffix padding sliced off.
         Forces a pending recorded chain (see :attr:`parray`)."""
-        arr = self.parray
+        arr = self._force_payload(_T_LARRAY)
         if not self.padded:
             return arr
         idx = [slice(None)] * arr.ndim
@@ -387,6 +403,7 @@ class DNDarray:
         if axis == self.__split:
             return self
         was_padded = self.padded
+        self._force_payload(_T_COLLECTIVE)  # redistribution = collective
         logical = self.larray
         self.__split = axis
         if axis is not None and self.__gshape[axis] % self.__comm.size != 0:
@@ -429,7 +446,7 @@ class DNDarray:
         self.__halo_size = halo_size
         self.__halo_cache = None
         if halo_size > 0 and self.__split is not None and self.__comm.size > 1:
-            phys = self.parray
+            phys = self._force_payload(_T_COLLECTIVE)
             block = int(phys.shape[self.__split]) // self.__comm.size
             if 0 < halo_size <= block:
                 fn = _halo_program(
@@ -664,6 +681,7 @@ class DNDarray:
         return out_dim + (self.__split - in_dim)
 
     def __getitem__(self, key) -> "DNDarray":
+        self._force_payload(_T_INDEXING)
         jkey = DNDarray._unwrap_key(key)
         result = self.larray[jkey]
         split = self._result_split(key) if result.ndim > 0 else None
@@ -680,6 +698,7 @@ class DNDarray:
         )
 
     def __setitem__(self, key, value):
+        self._force_payload(_T_INDEXING)
         jkey = DNDarray._unwrap_key(key)
         if isinstance(value, DNDarray):
             value = value.larray
@@ -880,7 +899,7 @@ class DNDarray:
         enclosing trace sees a concrete (or tracer) leaf, never a LazyArray.
         """
         aux = (self.__gshape, self.__dtype, self.__split, self.__device, self.__comm)
-        return (self.parray,), aux
+        return (self._force_payload(_T_PYTREE),), aux
 
     @classmethod
     def _tree_unflatten(cls, aux, children):

@@ -51,6 +51,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import telemetry
+
 __all__ = [
     "LazyArray",
     "active",
@@ -212,7 +214,7 @@ def cast(c, jax_dtype) -> LazyArray:
 # the sharded-program cache + materialization
 # ----------------------------------------------------------------------
 _PROGRAMS: "OrderedDict[tuple, callable]" = OrderedDict()
-_STATS = {"compiles": 0, "hits": 0, "forces": 0}
+_STATS = {"compiles": 0, "hits": 0, "forces": 0, "evictions": 0}
 
 
 def _leaf_sig(v):
@@ -274,6 +276,20 @@ def _build(sig):
     return run
 
 
+def _family(sig) -> tuple:
+    """The op identities of a signature, ignoring leaf shapes — the retrace
+    detector's key: the same family missing under churning shapes is the
+    recompile pathology worth warning about."""
+    return tuple(
+        getattr(e[0], "__name__", str(e[0])) for e in sig if e[0] not in ("L", "Ls")
+    )
+
+
+def _leaf_key(sig) -> tuple:
+    """The leaf (shape/dtype/sharding) part of a signature."""
+    return tuple(e for e in sig if e[0] in ("L", "Ls"))
+
+
 def force(node):
     """Materialize a recorded DAG as one cached, jitted XLA program.
 
@@ -287,16 +303,22 @@ def force(node):
         return node._value
     sig, leaves = _signature(node)
     prog = _PROGRAMS.get(sig)
-    if prog is None:
+    missed = prog is None
+    if missed:
         prog = jax.jit(_build(sig))
         _PROGRAMS[sig] = prog
         _STATS["compiles"] += 1
         while len(_PROGRAMS) > _CACHE_SIZE:
             _PROGRAMS.popitem(last=False)
+            _STATS["evictions"] += 1
+        if telemetry._MODE:
+            telemetry.record_retrace(_family(sig), _leaf_key(sig))
     else:
         _PROGRAMS.move_to_end(sig)
         _STATS["hits"] += 1
     _STATS["forces"] += 1
+    if telemetry._MODE:
+        telemetry.record_force(telemetry.current_trigger(), node.depth, compiled=missed)
     value = prog(*leaves)
     # under an enclosing trace the jit bind joins that trace and the value is
     # a tracer even though every leaf is concrete (verified on jax 0.4.37);
@@ -316,14 +338,17 @@ def is_deferred(x) -> bool:
 
 
 def cache_stats() -> dict:
-    """Program-cache counters (``compiles`` is the retrace count the
-    compile-count tests pin)."""
-    return dict(_STATS, size=len(_PROGRAMS))
+    """Program-cache counters: ``compiles`` (the retrace count the
+    compile-count tests pin), ``hits``, ``forces``, ``misses`` (an alias of
+    ``compiles`` — every miss compiles, counted once), ``evictions`` (LRU
+    drops past ``HEAT_TPU_FUSION_CACHE``) and the current cache ``size``."""
+    return dict(_STATS, misses=_STATS["compiles"], size=len(_PROGRAMS))
 
 
 def clear_cache() -> None:
+    """Drop every compiled program and zero ALL counters coherently."""
     _PROGRAMS.clear()
-    _STATS.update(compiles=0, hits=0, forces=0)
+    _STATS.update(compiles=0, hits=0, forces=0, evictions=0)
 
 
 # ----------------------------------------------------------------------

@@ -32,9 +32,13 @@ from typing import List, Optional, Tuple, Union
 import numpy as np
 
 from . import devices as devices_module
-from . import factories, types
+from . import factories, telemetry, types
 from .communication import sanitize_comm
 from .dndarray import DNDarray
+
+# every writer forces a pending recorded chain under the "io" trigger so the
+# blocking host read attributes to I/O in the telemetry forcing histogram
+_T_IO = telemetry.force_trigger("io")
 
 try:
     import h5py
@@ -213,6 +217,7 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
         raise TypeError(f"dataset must be str, but was {type(dataset)}")
     if mode not in ("w", "a", "r+"):
         raise ValueError(f"mode was {mode}, not in possible modes ('w', 'a', 'r+')")
+    data._force_payload(_T_IO)
     with h5py.File(path, mode) as handle:
         _write_h5_dataset(handle, dataset, data, **kwargs)
 
@@ -341,6 +346,7 @@ def save_netcdf(
         raise TypeError(f"variable must be str, but was {type(variable)}")
     if mode not in ("w", "a", "r+"):
         raise ValueError(f"mode was {mode}, not in possible modes ('w', 'a', 'r+')")
+    data._force_payload(_T_IO)
     if dimension_names is None:
         dimension_names = [f"{variable}_dim_{i}" for i in range(data.ndim)]
     elif len(dimension_names) != data.ndim:
@@ -426,6 +432,7 @@ def save_npy(data: DNDarray, path: str) -> None:
     if not isinstance(path, str):
         raise TypeError(f"path must be str, but was {type(path)}")
 
+    data._force_payload(_T_IO)
     npdtype = np.dtype(data.dtype.jax_type())
     if data.split is None or data.comm.size == 1 or data.ndim == 0:
         # file-object form: np.save(str_path) would append a '.npy' suffix,
@@ -567,6 +574,7 @@ def save_csv(
     if data.ndim > 2:
         raise ValueError("CSV can only store 1-D or 2-D arrays")
 
+    data._force_payload(_T_IO)
     if data.split == 1:
         from .manipulations import resplit as _resplit
 

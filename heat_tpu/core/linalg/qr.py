@@ -34,11 +34,16 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from .. import factories, sanitation, types
+from .. import factories, sanitation, telemetry, types
 from ..communication import sanitize_comm
 from ..dndarray import DNDarray, _ensure_split
 
 __all__ = ["qr"]
+
+# payload access here forces pending chains under the "collective" trigger,
+# and each schedule declares its collective budget to the telemetry ledger
+# (the schedule IS the algorithm — counts are per wrapper call)
+_T_COLLECTIVE = telemetry.force_trigger("collective")
 
 QR = collections.namedtuple("QR", "Q, R")
 
@@ -120,13 +125,17 @@ def qr(
         # try the MXU-native CholeskyQR2, fall back to Householder on the
         # breakdown/conditioning probe (one host scalar read; the probe also
         # catches finite-but-degraded orthogonality, see _cholqr2_kernel)
-        q_try, r_try, ok = _cholqr2_kernel(a.larray, calc_q)
+        with _T_COLLECTIVE:
+            q_try, r_try, ok = _cholqr2_kernel(a.larray, calc_q)
+        _record_cholqr2_collectives(a)  # the Gram psums ran either way
         if bool(ok):
             q_arr, r_arr = q_try, r_try
     elif method == "cholqr2":
         if m < n:
             raise ValueError(f"cholqr2 requires a tall operand (m >= n), got {a.shape}")
-        q_arr, r_arr, ok = _cholqr2_kernel(a.larray, calc_q)
+        with _T_COLLECTIVE:
+            q_arr, r_arr, ok = _cholqr2_kernel(a.larray, calc_q)
+        _record_cholqr2_collectives(a)
         if not bool(ok):
             raise ValueError(
                 "cholqr2 broke down (non-finite Cholesky of the Gram matrix, or "
@@ -180,6 +189,19 @@ def qr(
     return QR(q, r)
 
 
+def _record_cholqr2_collectives(a: DNDarray) -> None:
+    """Declared CholeskyQR2 schedule: each of the two passes' Gram
+    contractions psums one (n, n) partial over the split axis (GSPMD inserts
+    it when the operand rows are sharded; replicated operands move nothing)."""
+    if not telemetry._MODE or a.split != 0 or not a.comm.is_distributed():
+        return
+    n = int(a.shape[1])
+    acc = jnp.result_type(a.larray.dtype, jnp.float32)
+    telemetry.record_collective(
+        "allreduce", a.comm.axis_name, n * n * jnp.dtype(acc).itemsize, str(acc), count=2
+    )
+
+
 @functools.lru_cache(maxsize=None)
 def _tsqr_program(mesh, axis: str, block: int, n: int, p: int, dtype_name: str):
     """Compiled TSQR kernel over the row-padded (p*block, n) operand."""
@@ -222,8 +244,18 @@ def _tsqr(a: DNDarray, comm) -> Tuple[jax.Array, jax.Array]:
     """
     m, n = a.shape
     p = comm.size
-    phys = a.parray  # (p*block, n), zero rows past m
+    with _T_COLLECTIVE:
+        phys = a.parray  # (p*block, n), zero rows past m
     block = int(phys.shape[0]) // p
+    k1 = min(block, int(n))
+    if telemetry._MODE:
+        # declared schedule: ONE all_gather of the p (k1, n) R factors
+        telemetry.record_collective(
+            "allgather",
+            comm.axis_name,
+            p * k1 * int(n) * phys.dtype.itemsize,
+            str(phys.dtype),
+        )
     fn = _tsqr_program(comm.mesh, comm.axis_name, block, int(n), p, str(phys.dtype))
     q_pad, r = fn(phys)
     if a.padded:
@@ -298,9 +330,18 @@ def _panel_qr_split1(a: DNDarray, comm) -> Tuple[jax.Array, jax.Array]:
     """
     m, n = a.shape
     p = comm.size
-    phys = a.parray  # (m, p*c), zero columns past n
+    with _T_COLLECTIVE:
+        phys = a.parray  # (m, p*c), zero columns past n
     c = int(phys.shape[1]) // p
     n_pad = c * p
+    if telemetry._MODE:
+        # declared schedule: per panel, one (m, c) Q bcast + one (c, c) R bcast
+        telemetry.record_collective(
+            "bcast", comm.axis_name, int(m) * c * phys.dtype.itemsize, str(phys.dtype), count=p
+        )
+        telemetry.record_collective(
+            "bcast", comm.axis_name, c * c * phys.dtype.itemsize, str(phys.dtype), count=p
+        )
     fn = _panel_program(comm.mesh, comm.axis_name, int(m), c, n_pad, p, str(phys.dtype))
     q_pad, r_pad = fn(phys)
     if a.padded:

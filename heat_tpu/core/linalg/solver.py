@@ -20,9 +20,11 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from .. import factories, sanitation
+from .. import factories, sanitation, telemetry
 from ..dndarray import DNDarray
 from .basics import dot, matmul, norm, transpose
+
+_T_COLLECTIVE = telemetry.force_trigger("collective")
 
 __all__ = ["cg", "eigh", "eigvalsh", "lanczos", "solve", "solve_triangular"]
 
@@ -168,7 +170,14 @@ def solve_triangular(A: DNDarray, b: DNDarray, lower: bool = False) -> DNDarray:
         raise ValueError("b's leading dimension must match A")
 
     n = int(A.shape[0])
-    dtype = jnp.result_type(A.larray.dtype, b.larray.dtype, jnp.float32)
+    if A.split is not None and A.comm.size > 1:
+        # first payload access on the distributed path: pending chains force
+        # here and attribute to the collective schedule below; the local
+        # branch runs zero collectives and keeps plain larray attribution
+        with _T_COLLECTIVE:
+            dtype = jnp.result_type(A.larray.dtype, b.larray.dtype, jnp.float32)
+    else:
+        dtype = jnp.result_type(A.larray.dtype, b.larray.dtype, jnp.float32)
 
     if A.split is None or A.comm.size == 1:
         bl = b.larray.astype(dtype)
@@ -204,10 +213,20 @@ def solve_triangular(A: DNDarray, b: DNDarray, lower: bool = False) -> DNDarray:
         NamedSharding(comm.mesh, PartitionSpec(comm.axis_name, None)),
     )
 
+    if telemetry._MODE:
+        # declared schedule: one psum of one solved (rows_loc, k) block per stage
+        telemetry.record_collective(
+            "allreduce",
+            comm.axis_name,
+            rows_loc * k * jnp.dtype(dtype).itemsize,
+            dtype.name,
+            count=n_stages,
+        )
     fn = _tri_solve_program(
         comm.mesh, comm.axis_name, p, n, k, rows_loc, n_stages, owners, bool(lower), dtype.name
     )
-    x_pad = fn(A.parray, b_pad)
+    with _T_COLLECTIVE:
+        x_pad = fn(A.parray, b_pad)
     x = x_pad[:n]
     if vector_rhs:
         x = x[:, 0]

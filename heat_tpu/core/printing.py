@@ -10,7 +10,10 @@ from __future__ import annotations
 
 import numpy as np
 
+from . import telemetry
 from .communication import get_comm
+
+_T_PRINT = telemetry.force_trigger("print")
 
 __all__ = [
     "get_printoptions",
@@ -66,7 +69,8 @@ def print0(*args, **kwargs) -> None:
 def __str__(dndarray) -> str:
     """Global string representation (reference printing.py:208-264)."""
     opts = __PRINT_OPTIONS
-    body = _format_data(dndarray, opts)
+    with _T_PRINT:  # a repr that forces a pending chain reads as "print"
+        body = _format_data(dndarray, opts)
     return (
         f"DNDarray({body}, dtype=heat_tpu.{dndarray.dtype.__name__}, "
         f"device={dndarray.device}, split={dndarray.split})"

@@ -1,0 +1,633 @@
+"""Runtime telemetry layer: collective accounting, forcing-point attribution
+and retrace detection across the engines.
+
+The reference framework ships no profiling subsystem (SURVEY.md §5) and — per
+the Dask-MPI communication study (arxiv 2101.08878) and the array
+redistribution work (arxiv 2112.01075) — per-collective counts and bytes
+moved are the load-bearing metrics for diagnosing distributed array
+performance. This module measures them natively at the three hot seams
+instead of leaving tests and benches to infer them from HLO dumps:
+
+* **Collectives** — every ``MeshCommunication`` verb (``allreduce`` /
+  ``allgather`` / ``alltoall`` / ``ppermute`` / ``bcast`` / ``exscan`` /
+  ``scan``) records op type, mesh axis, dtype and logical bytes moved
+  (:func:`record_collective`, queried via :func:`collective_counts`).
+  The explicitly-scheduled linalg kernels (TSQR, panel QR, blocked
+  substitution) declare their schedule the same way. Counts are recorded at
+  *Python call time*: for in-kernel (``shard_map``) use that is once per
+  program trace; for the linalg wrappers it is once per wrapper call with
+  the schedule's declared multiplicity.
+* **Forcing points** — every ``fusion.force()`` is attributed to *what*
+  triggered it (``parray``/``larray`` access, ``print``, ``indexing``,
+  ``io``, ``collective``, ``pytree`` flatten) together with the chain depth
+  forced, so blocking host reads become attributable instead of invisible
+  (:func:`forcing_points`). Call sites scope themselves with
+  :func:`force_trigger`; the outermost scope wins.
+* **Compile/retrace tracking** — fusion-cache misses are keyed by *op
+  family* (the DAG's op identities, ignoring shapes); when the same family
+  keeps missing under different leaf shapes — shape churn defeating the
+  sharded-program cache — a :class:`RetraceWarning` fires exactly once per
+  family (:func:`record_retrace`). ``MeshCommunication.apply`` jit builds
+  are counted per kernel name (:func:`record_compile`).
+
+``HEAT_TPU_TELEMETRY={0,1,verbose}`` is the knob (read at import; in-process
+control via :func:`set_mode`/:func:`enabled`). Disabled is the default and
+costs one module-attribute check per instrumented site — the overhead guard
+in tests/test_telemetry.py pins the telemetry-enabled eager-chain dispatch
+rate at >= 0.9x the disabled rate. ``verbose`` additionally keeps a capped
+event log (:func:`events`).
+
+:func:`span` scopes all counters to a named region (spans nest —
+``"fit/iter"`` paths) and integrates with ``utils/profiling.Timer``: timers
+closing inside an active span are attributed to it, and every span records
+its own wall time into the Timer registry under ``span:<path>``.
+
+:func:`report` returns the whole picture as one structured dict;
+:func:`report_json` serializes it (optionally to a file).
+
+The module also owns the *compiled-program* side of collective accounting:
+:func:`hlo_collectives` / :func:`hlo_collective_counts` parse an XLA HLO
+dump into per-type collective instruction counts, and
+:func:`collective_budget_excess` diffs them against a named budget — the
+readable replacement for the hand-pinned ``len(coll) <= 7`` assertions the
+linalg suites used to carry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+import warnings
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "RetraceWarning",
+    "active",
+    "collective_budget_excess",
+    "collective_counts",
+    "collectives",
+    "current_trigger",
+    "dispatches",
+    "enabled",
+    "events",
+    "force_trigger",
+    "forcing_points",
+    "hlo_collective_counts",
+    "hlo_collectives",
+    "on_timer",
+    "operand_bytes",
+    "record_collective",
+    "record_collective_operand",
+    "record_compile",
+    "record_dispatch",
+    "record_force",
+    "record_retrace",
+    "report",
+    "report_json",
+    "reset",
+    "retraces",
+    "set_mode",
+    "span",
+    "spans",
+    "verbose",
+]
+
+
+class RetraceWarning(UserWarning):
+    """An op family keeps missing the fusion program cache under different
+    leaf shapes — shape churn is defeating the sharded-program cache and
+    every miss pays a fresh XLA compile."""
+
+
+_OFF_VALUES = ("", "0", "false", "off", "no")
+
+
+def _parse_mode(value) -> int:
+    if isinstance(value, bool):
+        return 1 if value else 0
+    if isinstance(value, int):
+        return max(0, min(2, value))
+    v = str(value).strip().lower()
+    if v in _OFF_VALUES:
+        return 0
+    if v in ("2", "verbose", "debug"):
+        return 2
+    return 1
+
+
+#: 0 = off, 1 = on, 2 = verbose. A module attribute (not a function) so the
+#: instrumented hot paths can gate on ``telemetry._MODE`` with one attribute
+#: read — the near-zero-overhead-when-disabled contract.
+_MODE = _parse_mode(os.environ.get("HEAT_TPU_TELEMETRY", "0"))
+
+#: distinct leaf-shape signatures a family may miss with before the one-shot
+#: shape-churn warning fires. First-time compiles of a handful of fixed
+#: shapes are normal warmup (each program stays cached afterwards); only a
+#: family that keeps producing NEW shapes — paying a fresh XLA compile per
+#: step — is the churn pathology, so the default sits well above warmup.
+_RETRACE_WARN_AFTER = int(os.environ.get("HEAT_TPU_TELEMETRY_RETRACE_WARN", "8"))
+
+_EVENT_CAP = 1024
+
+
+def active() -> bool:
+    """Whether telemetry is recording (``HEAT_TPU_TELEMETRY`` knob)."""
+    return _MODE > 0
+
+
+def verbose() -> bool:
+    """Whether the capped per-event log is kept (``HEAT_TPU_TELEMETRY=verbose``)."""
+    return _MODE >= 2
+
+
+def set_mode(mode) -> int:
+    """Set the telemetry mode in-process (0/off, 1/on, 2/'verbose');
+    returns the previous mode. Accepts the same spellings as the env knob."""
+    global _MODE
+    prev, _MODE = _MODE, _parse_mode(mode)
+    return prev
+
+
+@contextmanager
+def enabled(mode=1):
+    """Context manager running with telemetry on (tests, bench legs)."""
+    prev = set_mode(mode)
+    try:
+        yield
+    finally:
+        set_mode(prev)
+
+
+# ----------------------------------------------------------------------
+# counter state
+# ----------------------------------------------------------------------
+_COLLECTIVES: Dict[str, Dict[str, Any]] = {}
+_FORCES: Dict[str, Dict[str, Any]] = {}
+_RETRACES: Dict[tuple, Dict[str, Any]] = {}
+_COMPILES: Dict[str, int] = {}
+_DISPATCHES: Dict[str, Dict[str, int]] = {}
+_EVENTS: deque = deque(maxlen=_EVENT_CAP)
+
+_TRIGGER_STACK: List[str] = []
+_SPAN_STACK: list = []
+_SPANS: Dict[str, Dict[str, Any]] = {}
+
+
+def reset() -> None:
+    """Clear every counter, span and event (the mode is left untouched)."""
+    _COLLECTIVES.clear()
+    _FORCES.clear()
+    _RETRACES.clear()
+    _COMPILES.clear()
+    _DISPATCHES.clear()
+    _EVENTS.clear()
+    _SPANS.clear()
+
+
+# ----------------------------------------------------------------------
+# collectives
+# ----------------------------------------------------------------------
+def operand_bytes(x) -> int:
+    """Logical payload bytes of a pytree of arrays as seen at the call site
+    (per-participant shard bytes inside a ``shard_map`` kernel, global bytes
+    outside). Tracers count via their abstract shape; shapeless leaves
+    (python scalars) count zero."""
+    import numpy as np
+
+    total = 0
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(x)
+    except Exception:  # pragma: no cover - jax always importable here
+        leaves = [x]
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        total += int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    return total
+
+
+def record_collective_operand(op: str, axis: Optional[str], x, count: int = 1) -> None:
+    """Record a collective whose payload is the pytree ``x``: one leaf walk
+    derives both the logical bytes and the dtype (the communication verbs'
+    single call site). No-op when telemetry is off."""
+    if not _MODE:
+        return
+    import numpy as np
+
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(x)
+    except Exception:  # pragma: no cover - jax always importable here
+        leaves = [x]
+    total = 0
+    dtype = None
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dt = getattr(leaf, "dtype", None)
+        if shape is None or dt is None:
+            continue
+        total += int(np.prod(shape, dtype=np.int64)) * np.dtype(dt).itemsize
+        if dtype is None:
+            dtype = str(dt)
+    record_collective(op, axis, total, dtype, count)
+
+
+def record_collective(
+    op: str,
+    axis: Optional[str] = None,
+    nbytes: int = 0,
+    dtype: Optional[str] = None,
+    count: int = 1,
+) -> None:
+    """Record ``count`` logical collectives of type ``op`` moving ``nbytes``
+    over mesh axis ``axis``. Called by the communication verbs and the
+    declared linalg schedules; no-op when telemetry is off."""
+    if not _MODE:
+        return
+    rec = _COLLECTIVES.get(op)
+    if rec is None:
+        rec = _COLLECTIVES[op] = {"count": 0, "bytes": 0, "axes": {}, "dtypes": {}}
+    rec["count"] += count
+    rec["bytes"] += int(nbytes) * count
+    if axis is not None:
+        rec["axes"][axis] = rec["axes"].get(axis, 0) + count
+    if dtype is not None:
+        rec["dtypes"][dtype] = rec["dtypes"].get(dtype, 0) + count
+    if _MODE >= 2:
+        _EVENTS.append(
+            {"kind": "collective", "op": op, "axis": axis, "bytes": int(nbytes), "dtype": dtype, "count": count}
+        )
+    if _SPAN_STACK:
+        for frame in _SPAN_STACK:
+            frame.collectives[op] = frame.collectives.get(op, 0) + count
+
+
+def collective_counts() -> Dict[str, int]:
+    """Per-type logical collective counts — the assertable surface for tests
+    and benches: ``{"allreduce": 3, "allgather": 1, ...}``."""
+    return {op: rec["count"] for op, rec in _COLLECTIVES.items()}
+
+
+def collectives() -> Dict[str, Dict[str, Any]]:
+    """Full per-type accounting: count, bytes moved, per-axis and per-dtype
+    breakdowns."""
+    return {
+        op: {
+            "count": rec["count"],
+            "bytes": rec["bytes"],
+            "axes": dict(rec["axes"]),
+            "dtypes": dict(rec["dtypes"]),
+        }
+        for op, rec in _COLLECTIVES.items()
+    }
+
+
+# ----------------------------------------------------------------------
+# forcing-point attribution
+# ----------------------------------------------------------------------
+class _TriggerScope:
+    """Reentrant scope naming the forcing point for any ``fusion.force``
+    that fires inside it; the OUTERMOST scope wins (a print that forces via
+    ``larray`` is attributed to print, not larray)."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self) -> "_TriggerScope":
+        _TRIGGER_STACK.append(self.name)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _TRIGGER_STACK.pop()
+
+
+_TRIGGER_SCOPES: Dict[str, _TriggerScope] = {}
+
+
+def force_trigger(name: str) -> _TriggerScope:
+    """The (cached, reusable) attribution scope for forcing trigger ``name``."""
+    scope = _TRIGGER_SCOPES.get(name)
+    if scope is None:
+        scope = _TRIGGER_SCOPES[name] = _TriggerScope(name)
+    return scope
+
+
+def current_trigger() -> str:
+    """The attribution for a force firing right now (outermost scope, or the
+    bare-``parray``-access default)."""
+    return _TRIGGER_STACK[0] if _TRIGGER_STACK else "parray"
+
+
+def record_force(trigger: str, depth: int, compiled: bool = False) -> None:
+    """Record one materialized chain: ``trigger`` names the forcing point,
+    ``depth`` the recorded chain depth dispatched, ``compiled`` whether this
+    force paid a fresh XLA compile (cache miss)."""
+    if not _MODE:
+        return
+    rec = _FORCES.get(trigger)
+    if rec is None:
+        rec = _FORCES[trigger] = {"count": 0, "depth_total": 0, "max_depth": 0, "compiles": 0}
+    rec["count"] += 1
+    rec["depth_total"] += int(depth)
+    if depth > rec["max_depth"]:
+        rec["max_depth"] = int(depth)
+    if compiled:
+        rec["compiles"] += 1
+    if _MODE >= 2:
+        _EVENTS.append({"kind": "force", "trigger": trigger, "depth": int(depth), "compiled": compiled})
+    if _SPAN_STACK:
+        for frame in _SPAN_STACK:
+            frame.forces += 1
+
+
+def forcing_points() -> Dict[str, Dict[str, Any]]:
+    """Per-trigger forcing histogram: count, mean/max chain depth forced,
+    and how many of those forces paid a compile."""
+    out = {}
+    for trigger, rec in _FORCES.items():
+        out[trigger] = {
+            "count": rec["count"],
+            "mean_depth": round(rec["depth_total"] / rec["count"], 2) if rec["count"] else 0.0,
+            "max_depth": rec["max_depth"],
+            "compiles": rec["compiles"],
+        }
+    return out
+
+
+# ----------------------------------------------------------------------
+# compile / retrace tracking
+# ----------------------------------------------------------------------
+def record_retrace(family: tuple, shape_key) -> None:
+    """Record a fusion-cache miss for op ``family`` (the DAG's op identities)
+    under leaf-shape signature ``shape_key``. When one family accumulates
+    ``_RETRACE_WARN_AFTER`` distinct shape signatures, a
+    :class:`RetraceWarning` fires — exactly once per family."""
+    if not _MODE:
+        return
+    rec = _RETRACES.get(family)
+    if rec is None:
+        rec = _RETRACES[family] = {"misses": 0, "keys": set(), "warned": False}
+    rec["misses"] += 1
+    if not rec["warned"]:
+        # the key set only exists to cross the warn threshold; once warned,
+        # ``misses`` tracks volume and the set stops growing (shape churn is
+        # exactly the case that would otherwise accumulate keys unboundedly)
+        rec["keys"].add(shape_key)
+    if _SPAN_STACK:
+        for frame in _SPAN_STACK:
+            frame.retraces += 1
+    if not rec["warned"] and len(rec["keys"]) >= _RETRACE_WARN_AFTER:
+        rec["warned"] = True
+        warnings.warn(
+            RetraceWarning(
+                f"op family {'/'.join(family) or '<leaf>'} recompiled under "
+                f"{len(rec['keys'])} distinct input shapes ({rec['misses']} cache "
+                "misses): shape churn is defeating the fusion program cache — pad "
+                "or bucket the varying dimension, or force the chain before the "
+                "shape-dependent step"
+            ),
+            stacklevel=3,
+        )
+
+
+def retraces() -> Dict[str, Dict[str, Any]]:
+    """Per-op-family fusion-cache miss accounting."""
+    return {
+        "/".join(family) or "<leaf>": {
+            "misses": rec["misses"],
+            "distinct_shapes": len(rec["keys"]),
+            "warned": rec["warned"],
+        }
+        for family, rec in _RETRACES.items()
+    }
+
+
+def record_compile(label: str) -> None:
+    """Count a jit program build outside the fusion cache (e.g. one
+    ``MeshCommunication.apply`` kernel), keyed by kernel label."""
+    if not _MODE:
+        return
+    _COMPILES[label] = _COMPILES.get(label, 0) + 1
+
+
+# ----------------------------------------------------------------------
+# engine dispatch accounting
+# ----------------------------------------------------------------------
+def record_dispatch(engine: str, fused: bool) -> None:
+    """Count one L3-engine dispatch (``binary``/``local``/``reduce``/``cum``)
+    as deferred-into-the-DAG (``fused``) or eager."""
+    if not _MODE:
+        return
+    rec = _DISPATCHES.get(engine)
+    if rec is None:
+        rec = _DISPATCHES[engine] = {"fused": 0, "eager": 0}
+    rec["fused" if fused else "eager"] += 1
+
+
+def dispatches() -> Dict[str, Dict[str, int]]:
+    """Per-engine fused-vs-eager dispatch counts."""
+    return {k: dict(v) for k, v in _DISPATCHES.items()}
+
+
+# ----------------------------------------------------------------------
+# spans
+# ----------------------------------------------------------------------
+class _SpanFrame:
+    __slots__ = ("path", "t0", "collectives", "forces", "retraces", "timers")
+
+    def __init__(self, path: str):
+        self.path = path
+        self.t0 = time.perf_counter()
+        self.collectives: Dict[str, int] = {}
+        self.forces = 0
+        self.retraces = 0
+        self.timers: Dict[str, float] = {}
+
+
+@contextmanager
+def span(name: str):
+    """Scope all counters to a named region. Spans nest (``"fit"`` containing
+    ``"fit/iter"``), attribute the collective / forcing / retrace deltas that
+    occur inside them, absorb ``utils/profiling.Timer`` records closing
+    within them, and mirror their own wall time into the Timer registry as
+    ``span:<path>`` so the two report surfaces stay joined. Yields the full
+    span path (or None when telemetry is off)."""
+    if not _MODE:
+        yield None
+        return
+    path = (_SPAN_STACK[-1].path + "/" + name) if _SPAN_STACK else name
+    frame = _SpanFrame(path)
+    _SPAN_STACK.append(frame)
+    try:
+        yield path
+    finally:
+        _SPAN_STACK.pop()
+        elapsed = time.perf_counter() - frame.t0
+        rec = _SPANS.get(path)
+        if rec is None:
+            rec = _SPANS[path] = {
+                "calls": 0,
+                "total_s": 0.0,
+                "collectives": {},
+                "forces": 0,
+                "retraces": 0,
+                "timers": {},
+            }
+        rec["calls"] += 1
+        rec["total_s"] += elapsed
+        rec["forces"] += frame.forces
+        rec["retraces"] += frame.retraces
+        for op, cnt in frame.collectives.items():
+            rec["collectives"][op] = rec["collectives"].get(op, 0) + cnt
+        for tname, secs in frame.timers.items():
+            rec["timers"][tname] = rec["timers"].get(tname, 0.0) + secs
+        try:  # mirror into the Timer registry (utils/profiling nesting contract)
+            from ..utils import profiling
+
+            profiling.record_timing("span:" + path, elapsed)
+        except Exception:  # pragma: no cover - report must not die on import order
+            pass
+
+
+def on_timer(name: str, elapsed: float) -> None:
+    """Called by ``utils/profiling.Timer`` on every record so timers closing
+    inside an active span are attributed to EVERY enclosing span — the same
+    roll-up rule as collectives/forces (``span:`` mirrors excluded)."""
+    if not _SPAN_STACK or name.startswith("span:"):
+        return
+    for frame in _SPAN_STACK:
+        frame.timers[name] = frame.timers.get(name, 0.0) + elapsed
+
+
+def spans() -> Dict[str, Dict[str, Any]]:
+    """Per-span aggregates: calls, wall seconds, attributed collective
+    counts, forces, retraces and nested timer seconds."""
+    return {
+        path: {
+            "calls": rec["calls"],
+            "total_s": rec["total_s"],
+            "collectives": dict(rec["collectives"]),
+            "forces": rec["forces"],
+            "retraces": rec["retraces"],
+            "timers": dict(rec["timers"]),
+        }
+        for path, rec in _SPANS.items()
+    }
+
+
+# ----------------------------------------------------------------------
+# report
+# ----------------------------------------------------------------------
+def report() -> Dict[str, Any]:
+    """The whole telemetry picture as one structured dict (JSON-ready via
+    :func:`report_json`). Includes the fusion program-cache counters and the
+    ``utils/profiling`` timer registry so one call answers "where did the
+    time, the bytes and the compiles go"."""
+    doc: Dict[str, Any] = {
+        "enabled": active(),
+        "mode": {0: "off", 1: "on", 2: "verbose"}[_MODE],
+        "collectives": collectives(),
+        "collective_counts": collective_counts(),
+        "forcing_points": forcing_points(),
+        "dispatches": dispatches(),
+        "retraces": retraces(),
+        "jit_compiles": dict(_COMPILES),
+        "spans": spans(),
+    }
+    try:
+        from . import fusion
+
+        doc["fusion_cache"] = fusion.cache_stats()
+    except Exception:  # pragma: no cover
+        pass
+    try:
+        from ..utils import profiling
+
+        doc["timers"] = profiling.report()
+    except Exception:  # pragma: no cover
+        pass
+    if _MODE >= 2:
+        doc["events"] = list(_EVENTS)
+    return doc
+
+
+def report_json(path: Optional[str] = None, indent: int = 2) -> str:
+    """:func:`report` serialized to JSON; written to ``path`` when given."""
+    text = json.dumps(report(), indent=indent, default=str)
+    if path is not None:
+        with open(path, "w") as fh:
+            fh.write(text)
+            fh.write("\n")
+    return text
+
+
+def events() -> List[dict]:
+    """The capped verbose event log (empty unless ``HEAT_TPU_TELEMETRY=verbose``)."""
+    return list(_EVENTS)
+
+
+# ----------------------------------------------------------------------
+# compiled-program (HLO) collective accounting
+# ----------------------------------------------------------------------
+#: collective opcodes as they appear in HLO text, in call position
+#: (``all-reduce(...)`` / async ``all-reduce-start(...)``). Order matters:
+#: longest-prefix alternatives first so ``all-to-all`` never half-matches.
+_HLO_COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce-scatter|reduce-scatter|all-gather|all-reduce|all-to-all|"
+    r"collective-permute|collective-broadcast)(?:-start)?\("
+)
+
+
+def hlo_collectives(hlo_text: str) -> List[Dict[str, str]]:
+    """Collective *instructions* in an HLO dump: one entry per collective op
+    in call position (async ``-start``/``-done`` pairs count once, via the
+    start; instruction names and operand references never match). Each entry
+    carries the op type and its source line for byte-budget checks."""
+    out = []
+    for line in hlo_text.splitlines():
+        if "(" not in line or "=" not in line:
+            continue
+        # the regex requires "(" (or "-start(") right after the opcode, so
+        # async "-done(" companions and name/operand references never match
+        m = _HLO_COLLECTIVE_RE.search(line)
+        if m:
+            out.append({"op": m.group(1), "line": line.strip()})
+    return out
+
+
+def hlo_collective_counts(hlo_text: str) -> Dict[str, int]:
+    """Per-type collective instruction counts of a compiled HLO dump —
+    ``{"all-reduce": 3, "all-gather": 1}``. The readable replacement for
+    counting regex hits against a single magic number."""
+    counts: Dict[str, int] = {}
+    for entry in hlo_collectives(hlo_text):
+        counts[entry["op"]] = counts.get(entry["op"], 0) + 1
+    return counts
+
+
+def collective_budget_excess(
+    counts: Dict[str, int], budget: Dict[str, int]
+) -> Dict[str, str]:
+    """Violations of a named per-type collective budget: any type over its
+    allowance, or present but absent from the budget. Empty dict = within
+    budget. Asserting ``collective_budget_excess(...) == {}`` fails with a
+    diff that names the collective type instead of a magic total."""
+    excess = {}
+    for op, count in counts.items():
+        allowed = budget.get(op)
+        if allowed is None:
+            excess[op] = f"{count} present but not budgeted"
+        elif count > allowed:
+            excess[op] = f"{count} > budget {allowed}"
+    return excess

@@ -31,6 +31,7 @@ __all__ = [
     "Timer",
     "annotate",
     "device_memory_stats",
+    "record_timing",
     "report",
     "reset",
     "timed",
@@ -77,12 +78,23 @@ class Timer:
         if self.sync and exc == (None, None, None):
             _sync_all_devices()
         self.elapsed = time.perf_counter() - self._start
-        rec = self._registry.setdefault(
-            self.name, {"calls": 0, "total_s": 0.0, "best_s": float("inf")}
-        )
-        rec["calls"] += 1
-        rec["total_s"] += self.elapsed
-        rec["best_s"] = min(rec["best_s"], self.elapsed)
+        record_timing(self.name, self.elapsed)
+
+
+def record_timing(name: str, elapsed: float) -> None:
+    """Record one completed timing into the registry (the shared path for
+    ``Timer`` and ``heat_tpu.telemetry.span``). Active telemetry spans absorb
+    timers closing inside them (``ht.telemetry.span`` nesting contract)."""
+    rec = Timer._registry.setdefault(
+        name, {"calls": 0, "total_s": 0.0, "best_s": float("inf")}
+    )
+    rec["calls"] += 1
+    rec["total_s"] += elapsed
+    rec["best_s"] = min(rec["best_s"], elapsed)
+    from heat_tpu.core import telemetry
+
+    if telemetry._MODE:
+        telemetry.on_timer(name, elapsed)
 
 
 @functools.lru_cache(maxsize=None)

@@ -24,6 +24,12 @@ done
 echo "=== fusion off (HEAT_TPU_FUSION=0) ==="
 HEAT_TPU_FUSION=0 \
   python -m pytest tests/test_elementwise.py tests/test_eager_chain.py -q -x
+# telemetry leg: the observability layer (HEAT_TPU_TELEMETRY=1) must change
+# no results on the instrumented suites, and the overhead guard in
+# tests/test_telemetry.py pins the enabled dispatch rate at >= 0.9x disabled
+echo "=== telemetry on (HEAT_TPU_TELEMETRY=1) ==="
+HEAT_TPU_TELEMETRY=1 \
+  python -m pytest tests/test_telemetry.py tests/test_eager_chain.py tests/test_linalg_depth.py -q -x
 # the coverage gate (reference codecov.yml target semantics): the merged
 # matrix coverage must clear the floor or the matrix run fails. On runtimes
 # without sys.monitoring (Python < 3.12) no cov_mesh*.json legs are produced

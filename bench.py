@@ -437,6 +437,24 @@ def worker() -> None:
     if telem_new:
         print(json.dumps(record), flush=True)  # last parseable line wins
 
+    # guarded-dispatch overhead (core/resilience.py): the chain rate with the
+    # fault harness ARMED but never firing (an exhausted times=0 spec), so
+    # every injection-site check on the force/io hot paths is actually paid —
+    # "guards on, no faults". Runs AFTER the record is banked (hang-safety
+    # invariant: a stall here costs only this diagnostic field).
+    try:
+        if chain_fused:
+            from heat_tpu.core import resilience as _resilience
+
+            with _resilience.inject("bench.noop", times=0):
+                chain_guarded = _chain_rate()
+            record["guarded_dispatch_overhead_pct"] = round(
+                100.0 * (1.0 - chain_guarded / chain_fused), 1
+            )
+            print(json.dumps(record), flush=True)  # last parseable line wins
+    except Exception:  # noqa: BLE001 - diagnostics must never cost the record
+        pass
+
     # lloyd two-point marginal FIRST among the diagnostics, with the updated
     # record re-banked IMMEDIATELY after: a 10x-iteration program's time
     # spread cancels the per-program fixed cost (tunnel RTT ~67 ms measured

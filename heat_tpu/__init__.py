@@ -25,6 +25,7 @@ from .core import (
     memory,
     printing,
     relational,
+    resilience,
     rounding,
     sanitation,
     signal,

@@ -17,6 +17,8 @@ from .constants import *
 from .base import *
 from .stride_tricks import *
 from . import telemetry
+from . import resilience
+from .resilience import errstate
 from . import fusion
 from .dndarray import *
 from .factories import *

@@ -18,12 +18,23 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import devices, fusion, sanitation, telemetry, types
+from . import devices, fusion, resilience, sanitation, telemetry, types
 from .communication import sanitize_comm
 from .dndarray import DNDarray, _ensure_split
 from .stride_tricks import broadcast_shape, sanitize_axis
 
 __all__ = []  # private module, mirrors the reference
+
+
+def _nonfinite_checked(res: DNDarray) -> DNDarray:
+    """Numeric error policy (``ht.errstate``) for the eager engines: an op
+    that does not defer (``out=``/``where=``, foreign operands, fusion off)
+    never reaches a forcing point, so the check runs on the op's own logical
+    result — per-op error locality, exactly the reference's model. One
+    module-attribute read when the policy is off."""
+    if resilience._ERRSTATE is not None:
+        resilience.check_nonfinite(res.larray, "eager")
+    return res
 
 
 def _as_operand(x, comm, device):
@@ -76,6 +87,16 @@ def __binary_op(
             if telemetry._MODE:
                 telemetry.record_dispatch("binary", fused=True)
             return lazy
+        # defer_binary left its own (detailed) unfused breadcrumb
+    elif telemetry._MODE:
+        telemetry.record_unfused(
+            "binary",
+            "out="
+            if out is not None
+            else "where=" if where is not None
+            else "fusion_off" if not fusion.active()
+            else "unhashable_kwargs",
+        )
     if telemetry._MODE:
         telemetry.record_dispatch("binary", fused=False)
 
@@ -111,8 +132,8 @@ def __binary_op(
                 out._replace(
                     result.astype(out.dtype.jax_type()), out_split, gshape=out_shape
                 )
-                return out
-            return wrapped
+                return _nonfinite_checked(out)
+            return _nonfinite_checked(wrapped)
 
     a, s1 = _as_operand(t1, comm, device)
     b, s2 = _as_operand(t2, comm, device)
@@ -151,8 +172,8 @@ def __binary_op(
         out._replace(
             wrapped.parray.astype(out.dtype.jax_type()), out_split, gshape=wrapped.shape
         )
-        return out
-    return wrapped
+        return _nonfinite_checked(out)
+    return _nonfinite_checked(wrapped)
 
 
 def __local_op(
@@ -176,6 +197,9 @@ def __local_op(
             if telemetry._MODE:
                 telemetry.record_dispatch("local", fused=True)
             return lazy
+        # defer_local left its own (detailed) unfused breadcrumb
+    elif telemetry._MODE:
+        telemetry.record_unfused("local", "out=" if out is not None else "fusion_off")
     if telemetry._MODE:
         telemetry.record_dispatch("local", fused=False)
     padded = x.padded
@@ -218,8 +242,8 @@ def __local_op(
         out._replace(
             wrapped.parray.astype(out.dtype.jax_type()), wrapped.split, gshape=wrapped.shape
         )
-        return out
-    return wrapped
+        return _nonfinite_checked(out)
+    return _nonfinite_checked(wrapped)
 
 
 def __reduce_op(
@@ -263,6 +287,9 @@ def __reduce_op(
             if telemetry._MODE:
                 telemetry.record_dispatch("reduce", fused=True)
             return lazy
+        # defer_reduce left its own (detailed) unfused breadcrumb
+    elif telemetry._MODE:
+        telemetry.record_unfused("reduce", "out=" if out is not None else "fusion_off")
     if telemetry._MODE:
         telemetry.record_dispatch("reduce", fused=False)
 
@@ -309,8 +336,8 @@ def __reduce_op(
         out._replace(
             wrapped.parray.astype(out.dtype.jax_type()), wrapped.split, gshape=wrapped.shape
         )
-        return out
-    return wrapped
+        return _nonfinite_checked(out)
+    return _nonfinite_checked(wrapped)
 
 
 def __cum_op(
@@ -333,6 +360,9 @@ def __cum_op(
             if telemetry._MODE:
                 telemetry.record_dispatch("cum", fused=True)
             return lazy
+        # defer_cum left its own (detailed) unfused breadcrumb
+    elif telemetry._MODE:
+        telemetry.record_unfused("cum", "out=" if out is not None else "fusion_off")
     if telemetry._MODE:
         telemetry.record_dispatch("cum", fused=False)
     # pad-aware fast path: the padding is a *suffix* of the global split dim,
@@ -357,5 +387,5 @@ def __cum_op(
         out._replace(
             wrapped.parray.astype(out.dtype.jax_type()), wrapped.split, gshape=wrapped.shape
         )
-        return out
-    return wrapped
+        return _nonfinite_checked(out)
+    return _nonfinite_checked(wrapped)

@@ -38,7 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from . import telemetry
+from . import resilience, telemetry
 
 # NOTE: the lazy singletons (MESH_WORLD/MPI_WORLD/...) are deliberately NOT in
 # __all__ — a star import would force backend initialization at import time.
@@ -114,6 +114,8 @@ def _combine(op: Union[str, Callable]) -> Callable:
 def allreduce(x, axis: str, op: Union[str, Callable] = "sum", size: Optional[int] = None):
     """All-reduce ``x`` over mesh axis ``axis`` (reference Allreduce)."""
     telemetry.record_collective_operand("allreduce", axis, x)
+    if resilience._ARMED:
+        resilience.check("collective.allreduce")
     if op == "sum":
         return jax.tree.map(lambda l: jax.lax.psum(l, axis), x)
     if op == "mean":
@@ -147,6 +149,8 @@ def allgather(x, axis: str, gather_axis: int = 0, tiled: bool = False):
     ``tiled=False`` stacks a new axis at position ``gather_axis``;
     ``tiled=True`` concatenates along it."""
     telemetry.record_collective_operand("allgather", axis, x)
+    if resilience._ARMED:
+        resilience.check("collective.allgather")
     return jax.tree.map(lambda l: jax.lax.all_gather(l, axis, axis=gather_axis, tiled=tiled), x)
 
 
@@ -154,6 +158,8 @@ def alltoall(x, axis: str, split_axis: int = 0, concat_axis: int = 0):
     """All-to-all over the mesh axis (reference Alltoall(v/w)): scatter
     ``split_axis``, concatenate received pieces along ``concat_axis``."""
     telemetry.record_collective_operand("alltoall", axis, x)
+    if resilience._ARMED:
+        resilience.check("collective.alltoall")
     return jax.tree.map(
         lambda l: jax.lax.all_to_all(l, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True),
         x,
@@ -170,6 +176,8 @@ def ppermute(
     """Ring rotation: device ``d`` receives device ``(d + shift) % size``'s
     value; an explicit ``perm`` of (src, dst) pairs overrides ``shift``."""
     telemetry.record_collective_operand("ppermute", axis, x)
+    if resilience._ARMED:
+        resilience.check("collective.ppermute")
     if perm is None:
         perm = [(j, (j - shift) % size) for j in range(size)]
     return jax.tree.map(lambda l: jax.lax.ppermute(l, axis, perm), x)
@@ -179,6 +187,8 @@ def bcast(x, axis: str, root: int = 0):
     """Every device gets ``root``'s value — a masked psum: O(1) memory, no
     gather (reference Bcast, communication.py:544-600)."""
     telemetry.record_collective_operand("bcast", axis, x)
+    if resilience._ARMED:
+        resilience.check("collective.bcast")
     idx = jax.lax.axis_index(axis)
 
     def pick(l):
@@ -195,6 +205,8 @@ def exscan(x, axis: str, size: int, op: Union[str, Callable] = "sum", neutral=No
     the cumsum/cumprod workhorse _operations.py:268-295). Device 0 gets the
     neutral element."""
     telemetry.record_collective_operand("exscan", axis, x)
+    if resilience._ARMED:
+        resilience.check("collective.exscan")
     return _exscan_impl(x, axis, size, op, neutral)
 
 
@@ -222,6 +234,8 @@ def _exscan_impl(x, axis: str, size: int, op: Union[str, Callable], neutral):
 def pscan(x, axis: str, size: int, op: Union[str, Callable] = "sum", neutral=None):
     """Inclusive prefix combine over the device axis (reference Scan)."""
     telemetry.record_collective_operand("scan", axis, x)
+    if resilience._ARMED:
+        resilience.check("collective.scan")
     return _combine(op)(_exscan_impl(x, axis, size, op, neutral), x)
 
 
@@ -426,6 +440,8 @@ class MeshCommunication(Communication):
             # each apply() builds (and traces) a fresh jit program — the
             # retrace ledger keys them by kernel so repeat offenders show up
             telemetry.record_compile("apply:" + getattr(kernel, "__name__", "kernel"))
+        if resilience._ARMED:
+            resilience.check("collective.apply")
         fn = jax.jit(
             jax.shard_map(
                 kernel,

@@ -44,7 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import communication as comm_module
-from . import devices, fusion, telemetry, types
+from . import devices, fusion, resilience, telemetry, types
 from .communication import Communication, MeshCommunication
 from .stride_tricks import sanitize_axis
 
@@ -223,6 +223,18 @@ class DNDarray:
             split = self.__split
             if split is not None and (arr.ndim == 0 or split >= arr.ndim):
                 split = None
+            if resilience._ERRSTATE is not None:
+                # numeric error policy at the forcing seam, on the LOGICAL
+                # extent only: the padding suffix of a ragged split holds
+                # unspecified garbage (log(0) = -inf) and must not be
+                # checked. A raise leaves the wrapper unforced (the cached
+                # program makes re-forcing under "ignore" cheap).
+                check_val = arr
+                if split is not None and int(arr.shape[split]) != self.__gshape[split]:
+                    idx = [slice(None)] * arr.ndim
+                    idx[split] = slice(0, self.__gshape[split])
+                    check_val = arr[tuple(idx)]
+                resilience.check_nonfinite(check_val, "force")
             arr = _ensure_split(arr, split, self.__comm)
             self.__array = arr
         return arr
@@ -403,6 +415,11 @@ class DNDarray:
         if axis == self.__split:
             return self
         was_padded = self.padded
+        if resilience._ARMED:
+            # a preemption mid-redistribution is a classic pod failure mode;
+            # the site lets tests prove it surfaces BEFORE the wrapper's
+            # metadata is mutated (no half-resharded state)
+            resilience.check("collective.reshard")
         self._force_payload(_T_COLLECTIVE)  # redistribution = collective
         logical = self.larray
         self.__split = axis
@@ -521,7 +538,13 @@ class DNDarray:
         dtype = types.canonical_heat_type(dtype)
         arr = self.__array
         if isinstance(arr, fusion.LazyArray):
-            casted = fusion.cast(arr, dtype.jax_type())
+            try:
+                casted = fusion.cast(arr, dtype.jax_type())
+            except Exception as exc:  # same ONE policy as the defer_* sites
+                if not resilience.record_recoverable(exc):
+                    raise
+                # recording the cast failed: force the chain and cast eagerly
+                casted = self.parray.astype(dtype.jax_type())
         else:
             casted = arr.astype(dtype.jax_type())
         if copy:

@@ -37,6 +37,14 @@ Correctness stance
 ``HEAT_TPU_FUSION=0`` is the escape hatch: it disables recording (the eager
 engines run exactly as before); forcing of already-recorded nodes keeps
 working regardless of the flag.
+
+Guarded forcing (``core/resilience.py``): a fused program that fails to
+trace/compile/execute does NOT abort the chain — ``force()`` degrades to
+per-op eager dispatch (bitwise the eager engines' result), records a
+``degraded`` telemetry event, and quarantines the DAG key so steady-state
+loops skip the doomed compile from then on. The
+``fusion.record``/``fusion.compile``/``fusion.execute`` injection sites
+exist so tests can trigger exactly these failures deterministically.
 """
 
 from __future__ import annotations
@@ -51,7 +59,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import telemetry
+from . import resilience, telemetry
 
 __all__ = [
     "LazyArray",
@@ -61,6 +69,7 @@ __all__ = [
     "is_deferred",
     "cache_stats",
     "clear_cache",
+    "clear_quarantine",
 ]
 
 _OFF_VALUES = ("0", "false", "off", "no")
@@ -70,6 +79,10 @@ _OFF_VALUES = ("0", "false", "off", "no")
 # without limit in loops that never hit a forcing point
 _MAX_CHAIN = int(os.environ.get("HEAT_TPU_FUSION_MAX_CHAIN", "128"))
 _CACHE_SIZE = int(os.environ.get("HEAT_TPU_FUSION_CACHE", "512"))
+# DAG keys whose fused program failed once stay quarantined (replayed per-op
+# eagerly, never re-jitted) up to this many keys — steady-state loops must
+# not pay a doomed compile attempt every step
+_QUARANTINE_SIZE = int(os.environ.get("HEAT_TPU_FUSION_QUARANTINE", "256"))
 
 
 # the escape hatch is read ONCE at import (a per-op os.environ lookup is
@@ -175,9 +188,12 @@ def record(fn, children, **kw) -> LazyArray:
     """Record ``fn(*children, **kw)`` as a DAG node without dispatching it.
 
     ``kw`` values must be hashable (callers pre-check); shape/dtype are
-    inferred abstractly. Raises on inference failure — callers catch and fall
-    back to the eager engine, which reproduces the error eagerly.
+    inferred abstractly. Raises on inference failure — callers route the
+    exception through ``resilience.record_recoverable`` and fall back to the
+    eager engine, which reproduces the error eagerly.
     """
+    if resilience._ARMED:
+        resilience.check("fusion.record")
     kw_t = tuple(sorted(kw.items()))
     depth = 1 + max(
         (c.depth for c in children if isinstance(c, LazyArray) and c._value is None),
@@ -214,7 +230,17 @@ def cast(c, jax_dtype) -> LazyArray:
 # the sharded-program cache + materialization
 # ----------------------------------------------------------------------
 _PROGRAMS: "OrderedDict[tuple, callable]" = OrderedDict()
-_STATS = {"compiles": 0, "hits": 0, "forces": 0, "evictions": 0}
+# quarantined DAG keys: signatures whose fused program failed to build or
+# execute; forced via per-op eager replay from then on (guarded forcing)
+_QUARANTINE: "OrderedDict[tuple, None]" = OrderedDict()
+_STATS = {
+    "compiles": 0,
+    "hits": 0,
+    "forces": 0,
+    "evictions": 0,
+    "degraded": 0,
+    "quarantine_hits": 0,
+}
 
 
 def _leaf_sig(v):
@@ -290,39 +316,99 @@ def _leaf_key(sig) -> tuple:
     return tuple(e for e in sig if e[0] in ("L", "Ls"))
 
 
+def _quarantine(sig) -> None:
+    _QUARANTINE[sig] = None
+    while len(_QUARANTINE) > _QUARANTINE_SIZE:
+        _QUARANTINE.popitem(last=False)
+
+
+def _degrade(sig, leaves, exc, missed):
+    """Guarded forcing's recovery arm: the fused program for ``sig`` failed
+    to build (``missed``) or execute — drop it from the cache, quarantine the
+    DAG key (later forces skip the doomed compile and replay eagerly), record
+    a ``degraded`` telemetry event, warn once, and re-run the chain as per-op
+    eager dispatch. The replay produces the exact eager result; if IT fails,
+    the error surfaces with per-op locality — the reference's error model."""
+    import warnings
+
+    _PROGRAMS.pop(sig, None)
+    _quarantine(sig)
+    _STATS["degraded"] += 1
+    stage = "compile" if missed else "execute"
+    family = _family(sig)
+    if telemetry._MODE:
+        telemetry.record_degraded(family, stage, repr(exc))
+    warnings.warn(
+        resilience.DegradedDispatchWarning(
+            f"fused program for op chain {'/'.join(family) or '<leaf>'} failed at "
+            f"{stage} ({exc!r}); degraded to per-op eager dispatch and quarantined "
+            "the DAG key (correct result, slower — fusion.clear_cache() lifts the "
+            "quarantine)"
+        ),
+        stacklevel=4,
+    )
+    return _build(sig)(*leaves)
+
+
 def force(node):
     """Materialize a recorded DAG as one cached, jitted XLA program.
 
     Under an active trace (an enclosing ``jax.jit``/``eval_shape``) the
     program executes into that trace, so the result may be a tracer — it is
     then returned WITHOUT being cached on the node (caching a tracer would
-    leak it past the trace's lifetime)."""
+    leak it past the trace's lifetime).
+
+    GUARDED: a program that fails to trace/compile/execute (injectable at
+    the ``fusion.compile``/``fusion.execute`` sites) degrades to per-op
+    eager dispatch through :func:`_degrade` — one policy
+    (``resilience.force_recoverable``) decides what degrades, the DAG key is
+    quarantined, and the active ``ht.errstate`` policy is applied to the
+    materialized value either way."""
     if not isinstance(node, LazyArray):
         return node
     if node._value is not None:
         return node._value
     sig, leaves = _signature(node)
-    prog = _PROGRAMS.get(sig)
-    missed = prog is None
-    if missed:
-        prog = jax.jit(_build(sig))
-        _PROGRAMS[sig] = prog
-        _STATS["compiles"] += 1
-        while len(_PROGRAMS) > _CACHE_SIZE:
-            _PROGRAMS.popitem(last=False)
-            _STATS["evictions"] += 1
-        if telemetry._MODE:
-            telemetry.record_retrace(_family(sig), _leaf_key(sig))
-    else:
-        _PROGRAMS.move_to_end(sig)
-        _STATS["hits"] += 1
     _STATS["forces"] += 1
-    if telemetry._MODE:
-        telemetry.record_force(telemetry.current_trigger(), node.depth, compiled=missed)
-    value = prog(*leaves)
+    if _QUARANTINE and sig in _QUARANTINE:
+        # known-bad DAG key: skip the failing compile, replay per-op
+        _STATS["quarantine_hits"] += 1
+        if telemetry._MODE:
+            telemetry.record_force(telemetry.current_trigger(), node.depth, compiled=False)
+        value = _build(sig)(*leaves)
+    else:
+        prog = _PROGRAMS.get(sig)
+        missed = prog is None
+        if missed:
+            prog = jax.jit(_build(sig))
+            _PROGRAMS[sig] = prog
+            _STATS["compiles"] += 1
+            while len(_PROGRAMS) > _CACHE_SIZE:
+                _PROGRAMS.popitem(last=False)
+                _STATS["evictions"] += 1
+            if telemetry._MODE:
+                telemetry.record_retrace(_family(sig), _leaf_key(sig))
+        else:
+            _PROGRAMS.move_to_end(sig)
+            _STATS["hits"] += 1
+        if telemetry._MODE:
+            telemetry.record_force(telemetry.current_trigger(), node.depth, compiled=missed)
+        try:
+            if resilience._ARMED:
+                # jax.jit builds lazily, so the XLA compile happens inside the
+                # first call — the injection sites model that split
+                resilience.check("fusion.compile" if missed else "fusion.execute")
+            value = prog(*leaves)
+        except Exception as exc:  # noqa: BLE001 - routed through ONE policy
+            if not resilience.force_recoverable(exc):
+                raise
+            value = _degrade(sig, leaves, exc, missed)
     # under an enclosing trace the jit bind joins that trace and the value is
     # a tracer even though every leaf is concrete (verified on jax 0.4.37);
     # caching is gated on the value's actual concreteness, not ambient state
+    # (the errstate non-finite policy is applied at the DNDarray.parray seam,
+    # which knows the logical extent — the padding suffix of a ragged split
+    # holds unspecified garbage and must not be checked)
     if not isinstance(value, jax.core.Tracer):
         node._value = value
         # drop the recorded graph: later forces of ancestors treat this node
@@ -341,14 +427,32 @@ def cache_stats() -> dict:
     """Program-cache counters: ``compiles`` (the retrace count the
     compile-count tests pin), ``hits``, ``forces``, ``misses`` (an alias of
     ``compiles`` — every miss compiles, counted once), ``evictions`` (LRU
-    drops past ``HEAT_TPU_FUSION_CACHE``) and the current cache ``size``."""
-    return dict(_STATS, misses=_STATS["compiles"], size=len(_PROGRAMS))
+    drops past ``HEAT_TPU_FUSION_CACHE``), the current cache ``size``, plus
+    the guarded-forcing counters: ``degraded`` (programs that failed and
+    were replayed per-op), ``quarantine_hits`` (forces that skipped a
+    known-bad compile) and ``quarantined`` (currently quarantined keys)."""
+    return dict(
+        _STATS,
+        misses=_STATS["compiles"],
+        size=len(_PROGRAMS),
+        quarantined=len(_QUARANTINE),
+    )
 
 
 def clear_cache() -> None:
-    """Drop every compiled program and zero ALL counters coherently."""
+    """Drop every compiled program, lift every quarantine, and zero ALL
+    counters coherently."""
     _PROGRAMS.clear()
-    _STATS.update(compiles=0, hits=0, forces=0, evictions=0)
+    _QUARANTINE.clear()
+    _STATS.update(
+        compiles=0, hits=0, forces=0, evictions=0, degraded=0, quarantine_hits=0
+    )
+
+
+def clear_quarantine() -> None:
+    """Lift the quarantine only (keep compiled programs and counters): the
+    next force of a previously-failing DAG key retries the fused compile."""
+    _QUARANTINE.clear()
 
 
 # ----------------------------------------------------------------------
@@ -374,11 +478,25 @@ def _resolve_siblings():
 
 
 def hashable_kwargs(kw: dict) -> bool:
+    """Whether ``kw`` can be baked into a program-cache key. Only the
+    ``hash()`` itself is guarded — the sort runs outside the ``try`` so a
+    genuinely broken kwargs dict (unorderable keys) raises instead of being
+    silently classified as "unhashable, use the eager engine"."""
+    items = tuple(sorted(kw.items()))
     try:
-        hash(tuple(sorted(kw.items())))
+        hash(items)
         return True
-    except TypeError:
+    except TypeError:  # an unhashable VALUE (list/array kwarg): eager path
         return False
+
+
+def _unfused(engine: str, reason: str):
+    """The one-line telemetry breadcrumb every eager-fallback site leaves,
+    so ``telemetry.report()`` shows *why* a chain wasn't fused. Returns
+    None — callers ``return _unfused(...)`` to decline deferral."""
+    if telemetry._MODE:
+        telemetry.record_unfused(engine, reason)
+    return None
 
 
 def _phys_node(x):
@@ -430,22 +548,22 @@ def defer_binary(operation, t1, t2, jt, fn_kwargs):
     if getattr(operation, "_no_fusion", False):
         # impure engine ops (closures reading other DNDarrays, e.g. where's
         # cond-alignment op) must not be traced abstractly or cached
-        return None
+        return _unfused("binary", "no_fusion_op")
     d1, d2 = isinstance(t1, DNDarray), isinstance(t2, DNDarray)
     ref = t1 if d1 else t2
     if d1 and d2:
         if t1.comm is not t2.comm:
-            return None
+            return _unfused("binary", "mixed_comm")
         if t1.split == t2.split and t1.shape == t2.shape:
             a, b = _phys_node(t1), _phys_node(t2)
             if a is None or b is None:
-                return None
+                return _unfused("binary", "tracer_payload")
             out_shape, out_split = t1.shape, t1.split
             expected_phys = _aval(a)[0]
         elif not t1.padded and not t2.padded:
             a, b = _phys_node(t1), _phys_node(t2)
             if a is None or b is None:
-                return None
+                return _unfused("binary", "tracer_payload")
             # shape check stays eager-identical (error parity)
             out_shape = _broadcast_shape(t1.shape, t2.shape)
             expected_phys = out_shape
@@ -457,27 +575,29 @@ def defer_binary(operation, t1, t2, jt, fn_kwargs):
             if out_split is None:
                 out_split = _bcast_split(t2.split, t2.shape)
         else:
-            return None
+            return _unfused("binary", "padded_broadcast")
     elif d1 and isinstance(t2, _SCALARS):
         a, b = _phys_node(t1), t2
         if a is None:
-            return None
+            return _unfused("binary", "tracer_payload")
         out_shape, out_split = t1.shape, t1.split
         expected_phys = _aval(a)[0]
     elif d2 and isinstance(t1, _SCALARS):
         a, b = t1, _phys_node(t2)
         if b is None:
-            return None
+            return _unfused("binary", "tracer_payload")
         out_shape, out_split = t2.shape, t2.split
         expected_phys = _aval(b)[0]
     else:
-        return None  # np.ndarray / list / foreign operands: eager engine
+        return _unfused("binary", "foreign_operand")  # np.ndarray / list / ...
     try:
         node = record(operation, (cast(a, jt), cast(b, jt)), **fn_kwargs)
-    except Exception:  # noqa: BLE001 - op rejects the operands: eager raises it
-        return None
+    except Exception as exc:  # narrowed: ONE policy decides what falls back
+        if not resilience.record_recoverable(exc):
+            raise
+        return _unfused("binary", "record_failed:" + type(exc).__name__)
     if node.shape != tuple(expected_phys):
-        return None  # not elementwise after all — eager path owns it
+        return _unfused("binary", "shape_changed")  # not elementwise after all
     return _wrap(node, out_shape, out_split, ref)
 
 
@@ -486,20 +606,25 @@ def defer_local(operation, x, promote_jt, kwargs):
     stays in padding); None = use the eager engine."""
     if DNDarray is None:
         _resolve_siblings()
-    if getattr(operation, "_no_fusion", False) or not hashable_kwargs(kwargs):
-        return None
+    if getattr(operation, "_no_fusion", False):
+        return _unfused("local", "no_fusion_op")
+    if not hashable_kwargs(kwargs):
+        return _unfused("local", "unhashable_kwargs")
     n = _phys_node(x)
     if n is None:
-        return None
+        return _unfused("local", "tracer_payload")
     phys_shape = _aval(n)[0]
-    if promote_jt is not None:
-        n = cast(n, promote_jt)
     try:
+        # the promote cast records a node too — keep it inside the guard
+        if promote_jt is not None:
+            n = cast(n, promote_jt)
         node = record(operation, (n,), **kwargs)
-    except Exception:  # noqa: BLE001
-        return None
+    except Exception as exc:  # narrowed: ONE policy decides what falls back
+        if not resilience.record_recoverable(exc):
+            raise
+        return _unfused("local", "record_failed:" + type(exc).__name__)
     if node.shape != phys_shape:
-        return None  # shape-changing op: the eager engine's rare branch
+        return _unfused("local", "shape_changed")  # the eager engine's rare branch
     return _wrap(node, x.shape, x.split, x)
 
 
@@ -508,20 +633,27 @@ def defer_reduce(partial_op, x, axis, keepdims, out_split, dtype, kwargs):
     program + one sync at the forcing point. None = use the eager engine."""
     if DNDarray is None:
         _resolve_siblings()
-    if getattr(partial_op, "_no_fusion", False) or not hashable_kwargs(kwargs):
-        return None
+    if getattr(partial_op, "_no_fusion", False):
+        return _unfused("reduce", "no_fusion_op")
+    if not hashable_kwargs(kwargs):
+        return _unfused("reduce", "unhashable_kwargs")
     axes = None if axis is None else ((axis,) if isinstance(axis, int) else tuple(axis))
     padded_fast = x.padded and axes is not None and x.split not in axes
-    child = _phys_node(x) if (padded_fast or not x.padded) else _logical_node(x)
-    if child is None:
-        return None
     ax_kw = axis if (axis is None or isinstance(axis, int)) else tuple(axis)
     try:
+        # _logical_node records the un-pad slice, so it must sit INSIDE the
+        # guarded region: a record-time failure there (including an injected
+        # fusion.record fault) falls back to eager like any other
+        child = _phys_node(x) if (padded_fast or not x.padded) else _logical_node(x)
+        if child is None:
+            return _unfused("reduce", "tracer_payload")
         node = record(partial_op, (child,), axis=ax_kw, keepdims=keepdims, **kwargs)
         if dtype is not None:
             node = cast(node, _types.canonical_heat_type(dtype).jax_type())
-    except Exception:  # noqa: BLE001
-        return None
+    except Exception as exc:  # narrowed: ONE policy decides what falls back
+        if not resilience.record_recoverable(exc):
+            raise
+        return _unfused("reduce", "record_failed:" + type(exc).__name__)
     if padded_fast:
         gshape = list(x.shape)
         for a in sorted(axes, reverse=True):
@@ -541,17 +673,19 @@ def defer_cum(operation, x, axis, dtype):
     if DNDarray is None:
         _resolve_siblings()
     if getattr(operation, "_no_fusion", False):
-        return None
+        return _unfused("cum", "no_fusion_op")
     n = _phys_node(x)
     if n is None:
-        return None
+        return _unfused("cum", "tracer_payload")
     phys_shape = _aval(n)[0]
     try:
         node = record(operation, (n,), axis=axis)
         if dtype is not None:
             node = cast(node, _types.canonical_heat_type(dtype).jax_type())
-    except Exception:  # noqa: BLE001
-        return None
+    except Exception as exc:  # narrowed: ONE policy decides what falls back
+        if not resilience.record_recoverable(exc):
+            raise
+        return _unfused("cum", "record_failed:" + type(exc).__name__)
     if node.shape != phys_shape:
-        return None
+        return _unfused("cum", "shape_changed")
     return _wrap(node, x.shape, x.split, x)

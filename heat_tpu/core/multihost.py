@@ -22,6 +22,10 @@ Contract (documented in doc/internals_distribution.md):
   for "the local shard" in single-array views (``lshape``): the first
   rank addressable by this process, so every host reports a shard it
   actually holds.
+* ``io_owner()`` — whether this process performs the temp→target rename
+  publishing an atomic file write (``resilience.atomic_write``): exactly
+  one process may win the rename when every controller runs the same
+  ``save_*`` call.
 """
 
 from __future__ import annotations
@@ -32,6 +36,7 @@ import jax
 
 __all__ = [
     "process_index",
+    "io_owner",
     "is_addressable",
     "ranks_to_read",
     "representative_rank",
@@ -45,6 +50,18 @@ def process_index() -> int:
         return int(jax.process_index())
     except Exception:  # pragma: no cover - backend-dependent
         return 0
+
+
+def io_owner(proc: int | None = None) -> bool:
+    """Whether this process owns the *publication* step of a cooperative
+    file write (the temp→target rename of ``resilience.atomic_write``).
+
+    Under multi-controller SPMD every process runs the same ``save_*`` call
+    against the same target path; each writes a private temp, and exactly
+    one rename may win — process 0's, the same convention as the reference's
+    rank-0 responsibilities (reference io.py:198-226 token ring head). On a
+    single host this is always True."""
+    return (process_index() if proc is None else proc) == 0
 
 
 def is_addressable(device, proc: int | None = None) -> bool:

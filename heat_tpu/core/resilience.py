@@ -1,0 +1,617 @@
+"""Resilience layer: fault injection, recovery policy, numeric error policy
+and atomic/retrying I/O primitives.
+
+The reference framework (HeAT, arXiv:2007.13552) is eager op-by-op over MPI,
+so every failure is *local*: a bad op raises at its own call site, a dead
+rank kills the job loudly. Our fused engine (``core/fusion.py``) and
+streaming I/O gave that locality up for speed — a fused-program compile
+error would abort a whole 10-op chain, a mid-write exception would leave a
+truncated file on disk, and non-finite values ride silently through k-op
+chains. This module wins the locality back deliberately, and — because the
+only way to *trust* recovery paths on a CPU dev mesh is to trigger them —
+ships the deterministic fault-injection harness that produces the failures a
+real TPU pod does (preempted compiles, OOM, flaky NFS/GCS).
+
+Fault injection
+---------------
+Named **injection sites** are wired into the three seams:
+
+====================  =====================================================
+site                  where it fires
+====================  =====================================================
+``collective.<verb>`` each ``MeshCommunication`` verb at Python call time
+                      (``collective.allreduce``, ``collective.bcast``, ...)
+``collective.apply``  every ``MeshCommunication.apply`` shard_map build
+``collective.reshard``  redistribution (``DNDarray.resplit_``; halo builds
+                      fire ``collective.ppermute``/``collective.apply``)
+``fusion.record``     recording an op into the expression DAG
+``fusion.compile``    first execution (= XLA build) of a fused program
+``fusion.execute``    every execution of an already-cached fused program
+``io.read``           each per-device block read of the sharded ingest
+``io.write``          each (whole-file) write attempt of a ``save_*``
+``io.rename``         the temp-then-rename publication step
+====================  =====================================================
+
+:func:`inject` arms a site from a test or an experiment::
+
+    with ht.resilience.inject("fusion.compile", times=1):
+        y.larray     # the compile "fails"; force() degrades to eager
+
+``HEAT_TPU_FAULTS`` arms sites for a whole process — either the ``ci``
+preset (a deterministic background fault mix that every *recoverable* seam
+must absorb while the suite stays green) or an explicit spec list::
+
+    HEAT_TPU_FAULTS="io.write:exc=OSError:every=5,fusion.execute:every=11"
+
+Specs are deterministic: ``every=N`` is counter-based, ``p=<float>`` draws
+from a ``seed``-ed private RNG. While an :func:`inject` context is active,
+env/background specs are suspended, so tests asserting exact fault counts
+stay exact even under ``HEAT_TPU_FAULTS=ci``.
+
+Recovery policy
+---------------
+* :func:`record_recoverable` — the ONE policy for the fusion recorder's
+  record-time fallbacks (shape/tracing errors fall back to the eager engine,
+  real faults like ``MemoryError`` propagate).
+* :func:`force_recoverable` — the policy for fused-program build/execute
+  failures: ``fusion.force`` degrades the chain to per-op eager dispatch
+  (correct result, slower), records a ``degraded`` telemetry event and
+  quarantines the DAG key (see ``fusion.py``).
+* :class:`errstate` — the numeric error policy,
+  ``ht.errstate(nonfinite="warn"|"raise"|"ignore")``: forcing points run a
+  cheap jitted ``isfinite`` reduction on the materialized value and warn /
+  raise :class:`NonFiniteError` on inf/NaN. Off (``"ignore"``) by default;
+  disabled cost is one module-attribute read per force.
+
+Atomic + retrying I/O
+---------------------
+* :func:`atomic_write` — temp-then-rename publication: a crash mid-write
+  can never leave a partial file under the target name. Multihost-safe via
+  the ``multihost.py`` seam: every process writes a private temp, only the
+  owning process (``multihost.io_owner()``) renames, others discard.
+* :func:`call_with_retries` — capped exponential backoff over transient
+  ``OSError``s (:data:`retry_policy` is the knob; ``HEAT_TPU_IO_RETRIES`` /
+  ``HEAT_TPU_IO_RETRY_DELAY`` seed it). Non-transient errnos (``ENOENT``,
+  ``EACCES``...) never retry — error parity with the bare call.
+"""
+
+from __future__ import annotations
+
+import errno as errno_module
+import fnmatch
+import os
+import random
+import re
+import shutil
+import time
+import warnings
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from . import telemetry
+
+__all__ = [
+    "DegradedDispatchWarning",
+    "FaultInjected",
+    "NonFiniteError",
+    "NonFiniteWarning",
+    "RetryPolicy",
+    "atomic_write",
+    "call_with_retries",
+    "check",
+    "check_nonfinite",
+    "errstate",
+    "fault_counts",
+    "force_recoverable",
+    "inject",
+    "record_recoverable",
+    "reset",
+    "retry_policy",
+    "suspended",
+]
+
+
+class FaultInjected(RuntimeError):
+    """Default exception raised at an armed injection site."""
+
+
+class DegradedDispatchWarning(UserWarning):
+    """A fused program failed to build/execute and the chain was re-run as
+    per-op eager dispatch (correct result, slower); the DAG key is
+    quarantined so steady-state loops do not re-fail every step."""
+
+
+class NonFiniteError(FloatingPointError):
+    """Non-finite values detected at a forcing point under
+    ``ht.errstate(nonfinite="raise")``."""
+
+
+class NonFiniteWarning(RuntimeWarning):
+    """Non-finite values detected at a forcing point under
+    ``ht.errstate(nonfinite="warn")``."""
+
+
+# ----------------------------------------------------------------------
+# fault-injection harness
+# ----------------------------------------------------------------------
+_EXC_BY_NAME = {
+    "FaultInjected": FaultInjected,
+    "OSError": OSError,
+    "IOError": OSError,
+    "TimeoutError": TimeoutError,
+    "RuntimeError": RuntimeError,
+    "MemoryError": MemoryError,
+    "ValueError": ValueError,
+}
+
+
+class FaultSpec:
+    """One armed fault: a site pattern plus a deterministic firing rule.
+
+    ``times`` caps how often it fires (``times=0`` arms the machinery —
+    every site pays the check — but never fires: the "guards on, no faults"
+    overhead configuration). ``every=N`` fires on every Nth matching check;
+    ``p`` draws from a private ``seed``-ed RNG so runs are reproducible.
+    """
+
+    __slots__ = ("pattern", "exc", "times", "every", "p", "rng", "seen", "fired", "_regex")
+
+    def __init__(self, pattern, exc=FaultInjected, times=None, every=None, p=1.0, seed=0):
+        self.pattern = pattern
+        self.exc = exc
+        self.times = times
+        self.every = every
+        self.p = float(p)
+        self.rng = random.Random(seed)
+        self.seen = 0
+        self.fired = 0
+        self._regex = re.compile(fnmatch.translate(pattern))
+
+    def matches(self, site: str) -> bool:
+        return self._regex.match(site) is not None
+
+    def should_fire(self) -> bool:
+        self.seen += 1
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if self.every is not None and self.seen % self.every != 0:
+            return False
+        if self.p < 1.0 and self.rng.random() >= self.p:
+            return False
+        return True
+
+    def make(self, site: str) -> BaseException:
+        if isinstance(self.exc, BaseException):
+            return self.exc
+        if issubclass(self.exc, OSError):
+            # a *transient* I/O fault by construction (ETIMEDOUT/EIO are in
+            # the retry policy's transient set) — the failure mode worth
+            # injecting; TimeoutError IS an OSError and carries its errno
+            err = (
+                errno_module.ETIMEDOUT
+                if issubclass(self.exc, TimeoutError)
+                else errno_module.EIO
+            )
+            return self.exc(err, f"injected fault at {site}")
+        return self.exc(f"injected fault at {site}")
+
+    def __repr__(self) -> str:
+        return (
+            f"FaultSpec({self.pattern!r}, exc={getattr(self.exc, '__name__', self.exc)},"
+            f" times={self.times}, every={self.every}, p={self.p}, fired={self.fired})"
+        )
+
+
+def _parse_specs(text: str) -> List[FaultSpec]:
+    """``site:key=val:key=val, site2:...`` -> FaultSpecs (bad entries warn and
+    are skipped — a typo in an env knob must not take the process down)."""
+    specs: List[FaultSpec] = []
+    for entry in text.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        kwargs: dict = {}
+        try:
+            for part in parts[1:]:
+                key, _, val = part.partition("=")
+                key = key.strip()
+                if key == "exc":
+                    kwargs["exc"] = _EXC_BY_NAME[val.strip()]
+                elif key in ("times", "every", "seed"):
+                    kwargs[key] = int(val)
+                elif key == "p":
+                    kwargs["p"] = float(val)
+                else:
+                    raise KeyError(key)
+            specs.append(FaultSpec(parts[0].strip(), **kwargs))
+        except Exception as exc:  # noqa: BLE001 - env knob parsing only
+            warnings.warn(
+                f"HEAT_TPU_FAULTS: ignoring malformed entry {entry!r} ({exc!r})",
+                stacklevel=2,
+            )
+    return specs
+
+
+#: the CI background mix: only *recoverable-by-design* seams — fused programs
+#: degrade to eager, transient io errors are retried — so the matrix leg
+#: proves recovery by the suite simply staying green under ambient faults.
+#: Primes keep the sites' firing patterns from locking phase with each other.
+_PRESETS = {
+    "ci": (
+        "fusion.compile:every=13,"
+        "fusion.execute:every=11,"
+        "fusion.record:every=17,"
+        "io.write:exc=OSError:every=5,"
+        "io.read:exc=OSError:every=7"
+    ),
+}
+
+
+def _parse_env(value: str) -> List[FaultSpec]:
+    value = (value or "").strip()
+    if not value or value.lower() in ("0", "off", "false", "no"):
+        return []
+    return _parse_specs(_PRESETS.get(value.lower(), value))
+
+
+#: background specs from the env knob; suspended while an inject() is active
+_BACKGROUND: List[FaultSpec] = _parse_env(os.environ.get("HEAT_TPU_FAULTS", ""))
+#: specs from nested inject() contexts (innermost last; ALL active ones fire)
+_OVERLAY: List[FaultSpec] = []
+#: per-site fired counters (assertable surface; survives context exit)
+_FIRED: Dict[str, int] = {}
+
+#: module attribute so the instrumented hot paths gate with ONE attribute
+#: read when the harness is disarmed — the same near-zero-cost contract as
+#: ``telemetry._MODE``. True whenever any spec (background or overlay) is
+#: armed, including exhausted/never-firing ones: "guards on, no faults".
+_ARMED = bool(_BACKGROUND)
+
+
+def check(site: str) -> None:
+    """Raise the armed fault for ``site``, if any. Call sites gate on
+    ``resilience._ARMED`` so the disarmed cost is one attribute read."""
+    if not _ARMED:
+        return
+    for spec in _OVERLAY if _OVERLAY else _BACKGROUND:
+        if spec.matches(site) and spec.should_fire():
+            spec.fired += 1
+            _FIRED[site] = _FIRED.get(site, 0) + 1
+            if telemetry._MODE >= 2:
+                telemetry._EVENTS.append({"kind": "fault", "site": site, "pattern": spec.pattern})
+            raise spec.make(site)
+
+
+@contextmanager
+def inject(
+    site: str,
+    exc=FaultInjected,
+    times: Optional[int] = 1,
+    every: Optional[int] = None,
+    p: float = 1.0,
+    seed: int = 0,
+):
+    """Arm a fault at ``site`` (an fnmatch pattern) for the scope's duration.
+
+    Yields the :class:`FaultSpec` (inspect ``.fired`` afterwards). While any
+    ``inject`` scope is active the ``HEAT_TPU_FAULTS`` background specs are
+    suspended, so explicit tests stay exact under the CI fault mix. Nested
+    scopes compose: every active overlay spec is consulted.
+    """
+    global _ARMED
+    spec = FaultSpec(site, exc=exc, times=times, every=every, p=p, seed=seed)
+    _OVERLAY.append(spec)
+    _ARMED = True
+    try:
+        yield spec
+    finally:
+        _OVERLAY.remove(spec)
+        _ARMED = bool(_BACKGROUND) or bool(_OVERLAY)
+
+
+@contextmanager
+def suspended():
+    """Run with the ``HEAT_TPU_FAULTS`` background specs suspended (an
+    armed-but-never-firing overlay): tests that pin exact fault/degradation
+    counts shield themselves with this so they stay exact under the CI
+    ambient fault mix, while still paying the armed-site checks."""
+    with inject("__suspend__", times=0):
+        yield
+
+
+def fault_counts() -> Dict[str, int]:
+    """Per-site injected-fault counts (``collective_counts()``-style)."""
+    return dict(_FIRED)
+
+
+def reset() -> None:
+    """Zero the per-site fired counters (armed specs keep their own state)."""
+    _FIRED.clear()
+
+
+# ----------------------------------------------------------------------
+# recovery policies — THE one place that decides what falls back
+# ----------------------------------------------------------------------
+#: record-time failures that legitimately mean "this op/operand combination
+#: cannot be recorded abstractly" — the eager engine reproduces them (or
+#: handles the operands) with per-op locality
+_RECORD_FALLBACK_TYPES = (
+    TypeError,
+    ValueError,
+    NotImplementedError,
+    IndexError,
+    ArithmeticError,  # Overflow/ZeroDivision from abstract eval of scalars
+)
+
+
+def record_recoverable(exc: BaseException) -> bool:
+    """Whether a failure while *recording* an op into the fusion DAG should
+    fall back to the eager engine (True) or propagate (False).
+
+    Shape/dtype/tracing rejections fall back — the eager path either handles
+    the operands or raises the same error with per-op locality. Injected
+    record faults fall back too (that IS the recovery under test). Anything
+    else — ``MemoryError``, arbitrary internal errors — propagates: the old
+    bare ``except Exception`` would have silently swallowed a real fault
+    into a confusing second failure from the eager retry.
+    """
+    if isinstance(exc, FaultInjected):
+        return True
+    if isinstance(exc, _RECORD_FALLBACK_TYPES):
+        return True
+    # jax's own tracing machinery errors (ConcretizationTypeError & friends
+    # subclass neither of the above on every jax version)
+    return (type(exc).__module__ or "").startswith("jax")
+
+
+def force_recoverable(exc: BaseException) -> bool:
+    """Whether a fused-program build/execute failure should degrade the
+    chain to per-op eager dispatch. Everything a compile/runtime can throw —
+    including ``MemoryError`` (OOM compiles are exactly the TPU failure mode
+    worth surviving) — degrades; only our own numeric-policy signal
+    propagates, since it is raised *by* the forcing point, not by XLA."""
+    return not isinstance(exc, NonFiniteError)
+
+
+# ----------------------------------------------------------------------
+# numeric error policy: ht.errstate(nonfinite=...)
+# ----------------------------------------------------------------------
+_NONFINITE_MODES = ("ignore", "warn", "raise")
+
+#: None = ignore (default, zero-cost gate); "warn"/"raise" otherwise. The
+#: env knob seeds the initial state for whole-process runs (CI legs).
+_ERRSTATE: Optional[str] = None
+_env_nonfinite = os.environ.get("HEAT_TPU_NONFINITE", "ignore").strip().lower()
+if _env_nonfinite in ("warn", "raise"):
+    _ERRSTATE = _env_nonfinite
+
+_isfinite_prog = None
+
+
+class errstate:
+    """Numeric error policy scope: ``ht.errstate(nonfinite="warn")``.
+
+    ``nonfinite`` ∈ {"ignore", "warn", "raise"} controls what happens when a
+    value materialized at a forcing point contains inf/NaN: nothing (the
+    default — IEEE semantics, like the reference), a :class:`NonFiniteWarning`,
+    or a :class:`NonFiniteError`. The check is one cheap jitted
+    ``all(isfinite(x))`` reduction per force, runs only for inexact dtypes,
+    and composes with telemetry (each hit is recorded when telemetry is on).
+    ``numpy.errstate`` semantics: the policy applies on ``__enter__`` and the
+    previous one is restored on exit, so scopes nest and an instance can be
+    reused across ``with`` blocks. Process-wide configuration goes through
+    the ``HEAT_TPU_NONFINITE`` env knob.
+    """
+
+    def __init__(self, nonfinite: str = "ignore"):
+        if nonfinite not in _NONFINITE_MODES:
+            raise ValueError(
+                f"nonfinite must be one of {_NONFINITE_MODES}, got {nonfinite!r}"
+            )
+        self._mode = None if nonfinite == "ignore" else nonfinite
+        # a stack, not a slot: one instance may be entered reentrantly
+        # (with e: with e: ...) without leaking its policy on exit
+        self._prev_stack: List[Optional[str]] = []
+
+    def __enter__(self) -> "errstate":
+        global _ERRSTATE
+        self._prev_stack.append(_ERRSTATE)
+        _ERRSTATE = self._mode
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _ERRSTATE
+        _ERRSTATE = self._prev_stack.pop()
+
+
+def check_nonfinite(value, where: str = "force") -> None:
+    """Apply the active ``errstate`` policy to a materialized array.
+
+    Call sites gate on ``resilience._ERRSTATE`` (one attribute read when the
+    policy is off). Inexact dtypes only; the reduction is one jitted
+    ``all(isfinite(x))`` — jit caches one tiny program per shape/sharding,
+    and the scalar read is the only sync added."""
+    mode = _ERRSTATE
+    if mode is None:
+        return
+    dtype = getattr(value, "dtype", None)
+    if dtype is None:
+        return
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(value, jax.core.Tracer):
+        return  # inside an enclosing trace: nothing concrete to check
+
+    # jnp.issubdtype, not np: bfloat16 (the native TPU dtype) is inexact to
+    # the ml_dtypes hierarchy but NOT to numpy's — the np gate would silently
+    # exempt bf16 chains from the policy
+    if not jnp.issubdtype(dtype, jnp.inexact):
+        return
+    global _isfinite_prog
+    if _isfinite_prog is None:
+        _isfinite_prog = jax.jit(lambda x: jnp.all(jnp.isfinite(x)))
+    if bool(_isfinite_prog(value)):
+        return
+    if telemetry._MODE:
+        telemetry.record_nonfinite(where)
+    msg = (
+        f"non-finite values (inf/NaN) detected at {where} point "
+        f"(shape {tuple(getattr(value, 'shape', ()))}, dtype {np.dtype(dtype).name}) "
+        "under ht.errstate"
+    )
+    if mode == "raise":
+        raise NonFiniteError(msg)
+    warnings.warn(NonFiniteWarning(msg), stacklevel=3)
+
+
+# ----------------------------------------------------------------------
+# retrying I/O
+# ----------------------------------------------------------------------
+#: errnos worth retrying: scheduler blips, interrupted syscalls, and the
+#: device/network errors flaky NFS/GCS mounts produce. ENOENT/EACCES/ENOSPC
+#: and friends are NOT here — retrying them only delays the real error.
+_TRANSIENT_ERRNOS = frozenset(
+    getattr(errno_module, name)
+    for name in (
+        "EAGAIN", "EWOULDBLOCK", "EINTR", "EBUSY", "EIO", "ETIMEDOUT",
+        "ESTALE", "ECONNRESET", "ENETDOWN", "ENETUNREACH", "ENOBUFS",
+    )
+    if hasattr(errno_module, name)
+)
+
+
+class RetryPolicy:
+    """Capped exponential backoff over transient ``OSError``s.
+
+    ``retries`` extra attempts after the first (so ``retries=2`` = up to 3
+    calls), ``base_delay`` seconds before the first retry, doubling up to
+    ``max_delay``. :meth:`is_transient` is the classification seam."""
+
+    __slots__ = ("retries", "base_delay", "max_delay", "transient_errnos")
+
+    def __init__(
+        self,
+        retries: int = 2,
+        base_delay: float = 0.05,
+        max_delay: float = 1.0,
+        transient_errnos: frozenset = _TRANSIENT_ERRNOS,
+    ):
+        self.retries = int(retries)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.transient_errnos = transient_errnos
+
+    def is_transient(self, exc: BaseException) -> bool:
+        return isinstance(exc, OSError) and exc.errno in self.transient_errnos
+
+    def __repr__(self) -> str:
+        return (
+            f"RetryPolicy(retries={self.retries}, base_delay={self.base_delay},"
+            f" max_delay={self.max_delay})"
+        )
+
+
+#: the module-level knob every retrying I/O path consults (swap it, or set
+#: HEAT_TPU_IO_RETRIES / HEAT_TPU_IO_RETRY_DELAY before import)
+retry_policy = RetryPolicy(
+    retries=int(os.environ.get("HEAT_TPU_IO_RETRIES", "2")),
+    base_delay=float(os.environ.get("HEAT_TPU_IO_RETRY_DELAY", "0.05")),
+)
+
+
+def call_with_retries(site: str, fn: Callable, *args, policy: Optional[RetryPolicy] = None, **kwargs):
+    """Run ``fn(*args, **kwargs)``, retrying transient ``OSError``s with the
+    active :class:`RetryPolicy`'s capped exponential backoff. ``site`` names
+    the injection point checked before every attempt (so an injected
+    ``OSError`` exercises exactly the retry path a flaky mount would)."""
+    pol = policy if policy is not None else retry_policy
+    delay = pol.base_delay
+    attempt = 0
+    while True:
+        try:
+            if _ARMED:
+                check(site)
+            return fn(*args, **kwargs)
+        except OSError as exc:
+            if attempt >= pol.retries or not pol.is_transient(exc):
+                raise
+            attempt += 1
+            if telemetry._MODE:
+                telemetry.record_io_retry(site)
+            time.sleep(min(delay, pol.max_delay))
+            delay *= 2.0
+
+
+# ----------------------------------------------------------------------
+# atomic writes (temp-then-rename), multihost-safe
+# ----------------------------------------------------------------------
+def _unlink_quiet(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+@contextmanager
+def atomic_write(path: str, preserve: bool = False):
+    """Yield a private temp path next to ``path``; publish atomically on
+    success, leave NOTHING behind on failure.
+
+    The temp name embeds pid and process index so concurrent writers never
+    collide. On clean exit the *owning* process (``multihost.io_owner()`` —
+    process 0, the single-controller seam) renames temp→target via
+    ``os.replace`` (atomic on POSIX); non-owning processes discard their
+    temp, since under multi-controller SPMD every process calls ``save_*``
+    with the same target path and only one rename may win. On any exception
+    the temp is unlinked and the error propagates — a crashed save never
+    leaves a partial file under either name.
+
+    Scope: there is NO cross-process completion barrier — a non-owning
+    controller's ``save_*`` may return before (or without) the owner's
+    rename landing, so multi-controller read-after-save requires the
+    caller's own synchronization. What the protocol guarantees is that the
+    target path only ever holds a complete file (the old one, or the new
+    one); split streaming saves on a partially-addressable mesh refuse
+    outright (``io._rank_ordered_blocks``).
+
+    ``preserve=True`` seeds the temp with a copy of the existing target
+    (HDF5/netCDF append modes mutate in place; the copy keeps the append
+    atomic too).
+    """
+    from . import multihost
+
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    proc = multihost.process_index()
+    tmp = os.path.join(
+        directory, f".{os.path.basename(path)}.tmp-{os.getpid()}-{proc}"
+    )
+    if preserve and os.path.exists(path):
+        try:
+            shutil.copy2(path, tmp)
+        except BaseException:
+            # a failed seed copy (ENOSPC, transient EIO) must not orphan a
+            # partial temp: same leave-nothing-behind contract as the body
+            _unlink_quiet(tmp)
+            raise
+    try:
+        yield tmp
+    except BaseException:
+        _unlink_quiet(tmp)
+        raise
+    if not os.path.exists(tmp):
+        # writer wrote nothing (e.g. zero addressable shards): nothing to publish
+        return
+    if multihost.io_owner(proc):
+        try:
+            if _ARMED:
+                check("io.rename")
+            os.replace(tmp, path)
+        except BaseException:
+            _unlink_quiet(tmp)
+            raise
+    else:
+        _unlink_quiet(tmp)

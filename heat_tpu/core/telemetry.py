@@ -71,6 +71,8 @@ __all__ = [
     "collective_counts",
     "collectives",
     "current_trigger",
+    "degraded",
+    "degraded_counts",
     "dispatches",
     "enabled",
     "events",
@@ -78,14 +80,20 @@ __all__ = [
     "forcing_points",
     "hlo_collective_counts",
     "hlo_collectives",
+    "io_retries",
+    "nonfinite_counts",
     "on_timer",
     "operand_bytes",
     "record_collective",
     "record_collective_operand",
     "record_compile",
+    "record_degraded",
     "record_dispatch",
     "record_force",
+    "record_io_retry",
+    "record_nonfinite",
     "record_retrace",
+    "record_unfused",
     "report",
     "report_json",
     "reset",
@@ -93,6 +101,7 @@ __all__ = [
     "set_mode",
     "span",
     "spans",
+    "unfused_reasons",
     "verbose",
 ]
 
@@ -170,6 +179,10 @@ _FORCES: Dict[str, Dict[str, Any]] = {}
 _RETRACES: Dict[tuple, Dict[str, Any]] = {}
 _COMPILES: Dict[str, int] = {}
 _DISPATCHES: Dict[str, Dict[str, int]] = {}
+_DEGRADED: Dict[str, Dict[str, Any]] = {}
+_UNFUSED: Dict[str, Dict[str, int]] = {}
+_NONFINITE: Dict[str, int] = {}
+_IO_RETRIES: Dict[str, int] = {}
 _EVENTS: deque = deque(maxlen=_EVENT_CAP)
 
 _TRIGGER_STACK: List[str] = []
@@ -184,6 +197,10 @@ def reset() -> None:
     _RETRACES.clear()
     _COMPILES.clear()
     _DISPATCHES.clear()
+    _DEGRADED.clear()
+    _UNFUSED.clear()
+    _NONFINITE.clear()
+    _IO_RETRIES.clear()
     _EVENTS.clear()
     _SPANS.clear()
 
@@ -440,6 +457,92 @@ def dispatches() -> Dict[str, Dict[str, int]]:
     return {k: dict(v) for k, v in _DISPATCHES.items()}
 
 
+def record_unfused(engine: str, reason: str) -> None:
+    """One breadcrumb per eager-fallback site: ``engine`` declined to defer
+    an op for ``reason`` (``out=``, ``where=``, ``padded_broadcast``,
+    ``tracer_payload``, ``record_failed:<Type>``, ...) — so ``report()``
+    shows *why* a chain wasn't fused, not just that it wasn't."""
+    if not _MODE:
+        return
+    rec = _UNFUSED.get(engine)
+    if rec is None:
+        rec = _UNFUSED[engine] = {}
+    rec[reason] = rec.get(reason, 0) + 1
+
+
+def unfused_reasons() -> Dict[str, Dict[str, int]]:
+    """Per-engine reasons ops fell back to the eager engine instead of
+    deferring into the fusion DAG."""
+    return {k: dict(v) for k, v in _UNFUSED.items()}
+
+
+# ----------------------------------------------------------------------
+# resilience accounting (core/resilience.py)
+# ----------------------------------------------------------------------
+def record_degraded(family: tuple, stage: str, error: str = "") -> None:
+    """Record one guarded-forcing degradation: the fused program for op
+    ``family`` failed at ``stage`` (``compile``/``execute``) and the chain
+    was re-run as per-op eager dispatch (fusion quarantines the DAG key)."""
+    if not _MODE:
+        return
+    key = "/".join(family) or "<leaf>"
+    rec = _DEGRADED.get(key)
+    if rec is None:
+        rec = _DEGRADED[key] = {"count": 0, "stages": {}, "last_error": ""}
+    rec["count"] += 1
+    rec["stages"][stage] = rec["stages"].get(stage, 0) + 1
+    if error:
+        rec["last_error"] = error
+    if _MODE >= 2:
+        _EVENTS.append({"kind": "degraded", "family": key, "stage": stage, "error": error})
+
+
+def degraded_counts() -> Dict[str, int]:
+    """Per-op-family guarded-forcing degradation counts — the assertable
+    surface (``collective_counts()``-style) the resilience suite pins."""
+    return {key: rec["count"] for key, rec in _DEGRADED.items()}
+
+
+def degraded() -> Dict[str, Dict[str, Any]]:
+    """Full degradation accounting: count, per-stage breakdown, last error."""
+    return {
+        key: {
+            "count": rec["count"],
+            "stages": dict(rec["stages"]),
+            "last_error": rec["last_error"],
+        }
+        for key, rec in _DEGRADED.items()
+    }
+
+
+def record_nonfinite(where: str) -> None:
+    """Count one errstate non-finite detection at forcing point ``where``."""
+    if not _MODE:
+        return
+    _NONFINITE[where] = _NONFINITE.get(where, 0) + 1
+    if _MODE >= 2:
+        _EVENTS.append({"kind": "nonfinite", "where": where})
+
+
+def nonfinite_counts() -> Dict[str, int]:
+    """Per-forcing-point errstate non-finite detections."""
+    return dict(_NONFINITE)
+
+
+def record_io_retry(site: str) -> None:
+    """Count one transient-``OSError`` retry at I/O injection site ``site``."""
+    if not _MODE:
+        return
+    _IO_RETRIES[site] = _IO_RETRIES.get(site, 0) + 1
+    if _MODE >= 2:
+        _EVENTS.append({"kind": "io_retry", "site": site})
+
+
+def io_retries() -> Dict[str, int]:
+    """Per-site transient I/O retry counts."""
+    return dict(_IO_RETRIES)
+
+
 # ----------------------------------------------------------------------
 # spans
 # ----------------------------------------------------------------------
@@ -541,7 +644,11 @@ def report() -> Dict[str, Any]:
         "collective_counts": collective_counts(),
         "forcing_points": forcing_points(),
         "dispatches": dispatches(),
+        "unfused_reasons": unfused_reasons(),
         "retraces": retraces(),
+        "degraded": degraded(),
+        "nonfinite": nonfinite_counts(),
+        "io_retries": io_retries(),
         "jit_compiles": dict(_COMPILES),
         "spans": spans(),
     }

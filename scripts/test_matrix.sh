@@ -30,6 +30,14 @@ HEAT_TPU_FUSION=0 \
 echo "=== telemetry on (HEAT_TPU_TELEMETRY=1) ==="
 HEAT_TPU_TELEMETRY=1 \
   python -m pytest tests/test_telemetry.py tests/test_eager_chain.py tests/test_linalg_depth.py -q -x
+# resilience leg: the suite runs under the deterministic ambient fault mix
+# (core/resilience.py 'ci' preset: fused compiles/executes fail periodically
+# and degrade to eager, transient io errors are retried) — recovery is
+# proven by the suite simply staying green while faults fire. Explicit
+# inject() scopes suspend the ambient specs, so exact-count pins stay exact.
+echo "=== faults injected (HEAT_TPU_FAULTS=ci) ==="
+HEAT_TPU_FAULTS=ci HEAT_TPU_TELEMETRY=1 \
+  python -m pytest tests/test_resilience.py tests/test_resilience_io.py tests/test_io_errors.py -q -x
 # the coverage gate (reference codecov.yml target semantics): the merged
 # matrix coverage must clear the floor or the matrix run fails. On runtimes
 # without sys.monitoring (Python < 3.12) no cov_mesh*.json legs are produced

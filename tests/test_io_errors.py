@@ -10,6 +10,7 @@ import numpy as np
 import pytest
 
 import heat_tpu as ht
+from heat_tpu.core import resilience
 
 from harness import TestCase
 
@@ -27,6 +28,22 @@ class TestDispatch(TestCase):
     def test_save_unknown_extension(self):
         with pytest.raises(ValueError):
             ht.save(ht.ones(4), "data.unknown_ext")
+
+    def test_unknown_extension_error_lists_supported_formats(self):
+        # the refusal must teach: always-available formats by name, optional
+        # ones listed (or their missing dependency named) via supports_*()
+        with pytest.raises(ValueError) as exc_info:
+            ht.load("data.unknown_ext")
+        msg = str(exc_info.value)
+        self.assertIn(".unknown_ext", msg)
+        self.assertIn(".csv", msg)
+        self.assertIn(".npy", msg)
+        if ht.io.supports_hdf5():
+            self.assertIn(".h5", msg)
+        else:
+            self.assertIn("h5py", msg)  # the missing dep is named
+        if not ht.io.supports_netcdf():
+            self.assertIn("h5py", msg)
 
     def test_load_nonstring_path(self):
         with pytest.raises(TypeError):
@@ -104,6 +121,88 @@ class TestCSVErrors(TestCase):
             f.write("1;2;3\n4;5;6\n")
         x = ht.load_csv(path, sep=";")
         self.assert_array_equal(x, np.array([[1, 2, 3], [4, 5, 6]], np.float32))
+
+
+class TestTruncatedFiles(TestCase):
+    """Truncated on-disk bytes raise clean exceptions — the read-side half of
+    the resilience contract (the write side guarantees such files are never
+    *produced* by an interrupted save; see TestInterruptedSaves)."""
+
+    def test_npy_header_cut_mid_magic(self):
+        path = _tmp("trunc.npy")
+        ht.save_npy(ht.arange(16, dtype=ht.float32), path)
+        with open(path, "rb") as f:
+            head = f.read(4)  # half of the 6-byte \x93NUMPY magic
+        with open(path, "wb") as f:
+            f.write(head)
+        with pytest.raises((ValueError, OSError)):
+            ht.load_npy(path)
+        with pytest.raises((ValueError, OSError)):
+            ht.load_npy(path, split=0)
+
+    def test_npy_payload_cut_mid_data(self):
+        path = _tmp("trunc2.npy")
+        ht.save_npy(ht.arange(64, dtype=ht.float32), path)
+        size = os.path.getsize(path)
+        with open(path, "rb+") as f:
+            f.truncate(size - 64)  # header intact, data region short
+        with pytest.raises((ValueError, OSError)):
+            ht.load_npy(path, split=0)
+
+    def test_hdf5_truncated_mid_dataset(self):
+        import h5py
+
+        path = _tmp("trunc.h5")
+        with h5py.File(path, "w") as f:
+            f["data"] = np.arange(4096, dtype=np.float32)
+        size = os.path.getsize(path)
+        with open(path, "rb+") as f:
+            f.truncate(size // 2)
+        with pytest.raises((OSError, KeyError)):
+            ht.load_hdf5(path, "data", split=0)
+
+    def test_csv_truncated_mid_row(self):
+        path = _tmp("trunc.csv")
+        # a complete first row, then a row cut mid-field (no trailing value)
+        with open(path, "w") as f:
+            f.write("1.0,2.0,3.0\n4.0,")
+        with pytest.raises(ValueError):
+            ht.load_csv(path, split=0)
+        with pytest.raises(ValueError):
+            ht.load_csv(path)
+
+
+class TestInterruptedSaves(TestCase):
+    """An interrupted save (injected persistent write faults) raises AND
+    leaves no partial/temp output files behind — temp-then-rename means the
+    truncated files above can only come from outside this process."""
+
+    def setUp(self):
+        self._prev_policy = resilience.retry_policy
+        resilience.retry_policy = resilience.RetryPolicy(retries=1, base_delay=0.001)
+
+    def tearDown(self):
+        resilience.retry_policy = self._prev_policy
+
+    def test_no_partial_files_after_interrupted_saves(self):
+        d = pathlib.Path(tempfile.mkdtemp())
+        x = ht.array(np.arange(24, dtype=np.float32).reshape(6, 4), split=0)
+        saves = [
+            ("p.npy", lambda p: ht.save_npy(x, p)),
+            ("p.h5", lambda p: ht.save_hdf5(x, p, "d")),
+            ("p.nc", lambda p: ht.save_netcdf(x, p, "v")),
+            ("p.csv", lambda p: ht.save_csv(x, p)),
+        ]
+        with resilience.suspended():
+            for name, save in saves:
+                # io.write faults BEFORE the body (the attempt never starts);
+                # io.rename faults AFTER the temp is fully written — both
+                # interruption points must leave the directory spotless
+                for site in ("io.write", "io.rename"):
+                    with resilience.inject(site, exc=OSError, times=None):
+                        with pytest.raises(OSError):
+                            save(str(d / name))
+        self.assertEqual(sorted(os.listdir(d)), [], "interrupted saves left files")
 
 
 class TestNetCDFErrors(TestCase):

@@ -1,0 +1,251 @@
+"""Atomic + retrying I/O (core/resilience.py wired through core/io.py).
+
+Pins the ISSUE-3 acceptance criterion: an injected ``OSError`` on the first
+write attempt of ``save_npy``/``save_hdf5`` succeeds via retry and
+``os.listdir`` shows no temp/partial files afterward; exhausted retries
+raise and STILL leave nothing behind. Every test uses a fast retry policy
+(no real backoff sleeps) and shields itself with ``resilience.suspended()``
+where exact attempt counts matter.
+"""
+
+import os
+import pathlib
+import tempfile
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core import multihost, resilience, telemetry
+
+from harness import TestCase
+
+
+class IOCase(TestCase):
+    def setUp(self):
+        self.dir = pathlib.Path(tempfile.mkdtemp())
+        self._prev_policy = resilience.retry_policy
+        resilience.retry_policy = resilience.RetryPolicy(retries=2, base_delay=0.001)
+        self.x_np = np.arange(24, dtype=np.float32).reshape(6, 4)
+        self.x = ht.array(self.x_np, split=0)
+
+    def tearDown(self):
+        resilience.retry_policy = self._prev_policy
+
+    def _listing(self):
+        return sorted(os.listdir(self.dir))
+
+
+class TestRetryingSaves(IOCase):
+    """Acceptance: one injected transient OSError per save, retried to
+    success, nothing but the final file on disk."""
+
+    def test_save_npy_retries_first_write_fault(self):
+        path = str(self.dir / "a.npy")
+        with resilience.suspended():
+            with resilience.inject("io.write", exc=OSError, times=1) as spec:
+                ht.save_npy(self.x, path)
+        self.assertEqual(spec.fired, 1)
+        self.assertEqual(self._listing(), ["a.npy"])  # no temp/partial files
+        self.assert_array_equal(ht.load_npy(path, split=0), self.x_np)
+
+    def test_save_hdf5_retries_first_write_fault(self):
+        path = str(self.dir / "b.h5")
+        with resilience.suspended():
+            with resilience.inject("io.write", exc=OSError, times=1) as spec:
+                ht.save_hdf5(self.x, path, "data")
+        self.assertEqual(spec.fired, 1)
+        self.assertEqual(self._listing(), ["b.h5"])
+        self.assert_array_equal(ht.load_hdf5(path, "data", split=0), self.x_np)
+
+    def test_save_csv_retries_first_write_fault(self):
+        path = str(self.dir / "c.csv")
+        with resilience.suspended():
+            with resilience.inject("io.write", exc=OSError, times=1):
+                ht.save_csv(self.x, path)
+        self.assertIn("c.csv", self._listing())
+        self.assertFalse(any(f.startswith(".") for f in self._listing()))
+        self.assert_array_equal(ht.load_csv(path, split=0), self.x_np)
+
+    def test_rename_fault_retries_whole_attempt(self):
+        path = str(self.dir / "d.npy")
+        with resilience.suspended():
+            with resilience.inject("io.rename", exc=OSError, times=1) as spec:
+                ht.save_npy(self.x, path)
+        self.assertEqual(spec.fired, 1)
+        self.assertEqual(self._listing(), ["d.npy"])
+        self.assert_array_equal(ht.load_npy(path, split=0), self.x_np)
+
+    def test_retries_are_counted_in_telemetry(self):
+        path = str(self.dir / "t.npy")
+        with resilience.suspended(), telemetry.enabled():
+            telemetry.reset()
+            with resilience.inject("io.write", exc=OSError, times=1):
+                ht.save_npy(self.x, path)
+            self.assertEqual(telemetry.io_retries().get("io.write"), 1)
+            self.assertIn("io_retries", telemetry.report())
+
+
+class TestExhaustedAndNonTransient(IOCase):
+    def test_exhausted_retries_raise_and_leave_nothing(self):
+        for name, save in (
+            ("e.npy", lambda p: ht.save_npy(self.x, p)),
+            ("e.h5", lambda p: ht.save_hdf5(self.x, p, "data")),
+            ("e.csv", lambda p: ht.save_csv(self.x, p)),
+        ):
+            path = str(self.dir / name)
+            with resilience.suspended():
+                with resilience.inject("io.write", exc=OSError, times=None):
+                    with pytest.raises(OSError):
+                        save(path)
+            self.assertNotIn(name, self._listing(), name)
+        # interrupted saves leave NO partial/temp files behind
+        self.assertEqual(self._listing(), [])
+
+    def test_non_transient_oserror_does_not_retry(self):
+        # ENOENT (missing directory) must surface immediately, not after
+        # retries-worth of backoff: classification is by errno
+        missing = str(self.dir / "no" / "such" / "dir" / "x.npy")
+        calls = []
+        orig = resilience.retry_policy.is_transient
+
+        class Spy(resilience.RetryPolicy):
+            def is_transient(self, exc):
+                calls.append(exc)
+                return orig(exc)
+
+        resilience.retry_policy = Spy(retries=2, base_delay=0.001)
+        with pytest.raises((FileNotFoundError, OSError)):
+            ht.save_npy(self.x, missing)
+        self.assertEqual(len(calls), 1)  # classified once, never retried
+
+    def test_rplus_on_missing_target_names_the_users_path(self):
+        # the error must name the target, not the hidden staging temp
+        missing = str(self.dir / "never_existed.h5")
+        with pytest.raises(FileNotFoundError) as exc_info:
+            ht.save_hdf5(self.x, missing, "d", mode="r+")
+        self.assertIn("never_existed.h5", str(exc_info.value))
+        self.assertNotIn(".tmp-", str(exc_info.value))
+        self.assertEqual(self._listing(), [])
+
+    def test_failed_append_keeps_original_intact(self):
+        # an interrupted append must not corrupt the existing file: the temp
+        # copy absorbs the damage, the target is untouched. io.rename faults
+        # fire AFTER the append body fully ran against the temp, so this
+        # drives the writer end-to-end, not just the pre-write check
+        path = str(self.dir / "keep.h5")
+        ht.save_hdf5(self.x, path, "one")
+        with resilience.suspended():
+            for site in ("io.write", "io.rename"):
+                with resilience.inject(site, exc=OSError, times=None):
+                    with pytest.raises(OSError):
+                        ht.save_hdf5(ht.arange(4, dtype=ht.float32), path, "two", mode="a")
+        self.assertEqual(self._listing(), ["keep.h5"])
+        self.assert_array_equal(ht.load_hdf5(path, "one", split=0), self.x_np)
+        with pytest.raises(KeyError):
+            ht.load_hdf5(path, "two")  # the aborted append never published
+
+
+class TestRetryingReads(IOCase):
+    def test_sharded_npy_read_retries(self):
+        path = str(self.dir / "r.npy")
+        ht.save_npy(self.x, path)
+        with resilience.suspended():
+            with resilience.inject("io.read", exc=OSError, times=1) as spec:
+                back = ht.load_npy(path, split=0)
+        self.assertEqual(spec.fired, 1)
+        self.assert_array_equal(back, self.x_np)
+
+    def test_sharded_hdf5_read_retries(self):
+        path = str(self.dir / "r.h5")
+        ht.save_hdf5(self.x, path, "data")
+        with resilience.suspended():
+            with resilience.inject("io.read", exc=OSError, times=1):
+                back = ht.load_hdf5(path, "data", split=0)
+        self.assert_array_equal(back, self.x_np)
+
+    def test_read_retries_exhaust_to_the_real_error(self):
+        path = str(self.dir / "r2.npy")
+        ht.save_npy(self.x, path)
+        with resilience.suspended():
+            with resilience.inject("io.read", exc=OSError, times=None):
+                with pytest.raises(OSError):
+                    ht.load_npy(path, split=0)
+
+
+class TestMultihostPublication(IOCase):
+    """Only the owning process renames (the multihost.py seam)."""
+
+    def test_io_owner_is_process_zero(self):
+        self.assertTrue(multihost.io_owner(0))
+        self.assertFalse(multihost.io_owner(1))
+        self.assertTrue(multihost.io_owner())  # single-controller: process 0
+
+    def test_non_owner_discards_instead_of_publishing(self):
+        path = str(self.dir / "never.npy")
+        prev = multihost.io_owner
+        multihost.io_owner = lambda proc=None: False
+        try:
+            ht.save_npy(self.x, path)
+        finally:
+            multihost.io_owner = prev
+        # nothing published, nothing leaked
+        self.assertEqual(self._listing(), [])
+
+    def test_split_streaming_save_refuses_partial_addressability(self):
+        # a controller that cannot address the whole mesh must refuse the
+        # streaming split save loudly (a single-file write would be short),
+        # and leave nothing behind
+        if self.get_size() == 1:
+            self.skipTest("a 1-device mesh takes the replicated fast path")
+        prev = multihost.process_index
+        multihost.process_index = lambda: 1  # pose as a non-zero controller
+        try:
+            with pytest.raises(NotImplementedError):
+                ht.save_npy(self.x, str(self.dir / "part.npy"))
+            with pytest.raises(NotImplementedError):
+                ht.save_hdf5(self.x, str(self.dir / "part.h5"), "d")
+        finally:
+            multihost.process_index = prev
+        self.assertEqual(self._listing(), [])
+
+    def test_atomic_write_primitive_cleans_on_error(self):
+        target = str(self.dir / "prim.bin")
+        with pytest.raises(RuntimeError):
+            with resilience.atomic_write(target) as tmp:
+                with open(tmp, "wb") as fh:
+                    fh.write(b"partial")
+                raise RuntimeError("writer died mid-stream")
+        self.assertEqual(self._listing(), [])
+
+    def test_failed_preserve_copy_leaves_nothing(self):
+        # regression: a failed seed copy (append modes) must clean its own
+        # partial temp — same leave-nothing-behind contract as the body
+        import shutil
+
+        target = str(self.dir / "seed.h5")
+        ht.save_hdf5(self.x, target, "one")
+        orig = shutil.copy2
+
+        def dying_copy(src, dst, **kw):
+            with open(dst, "wb") as fh:
+                fh.write(b"partial")  # the copy got partway...
+            raise OSError(28, "No space left on device")
+
+        shutil.copy2 = dying_copy
+        try:
+            with pytest.raises(OSError):
+                ht.save_hdf5(ht.arange(4, dtype=ht.float32), target, "two", mode="a")
+        finally:
+            shutil.copy2 = orig
+        self.assertEqual(self._listing(), ["seed.h5"])  # no temp orphaned
+        self.assert_array_equal(ht.load_hdf5(target, "one", split=0), self.x_np)
+
+    def test_atomic_write_publishes_complete_files_only(self):
+        target = str(self.dir / "ok.bin")
+        with resilience.atomic_write(target) as tmp:
+            with open(tmp, "wb") as fh:
+                fh.write(b"complete")
+        self.assertEqual(self._listing(), ["ok.bin"])
+        with open(target, "rb") as fh:
+            self.assertEqual(fh.read(), b"complete")

@@ -9,6 +9,7 @@ collectives the reference hand-codes over MPI. See SURVEY.md for the blueprint.
 from .core import *
 from .core import linalg, random
 from . import classification, cluster, datasets, graph, naive_bayes, nn, ops, optim, regression, spatial, utils
+from .utils import checkpoint  # ht.checkpoint — the verified sharded checkpoint subsystem
 from .core import (
     arithmetics,
     base,

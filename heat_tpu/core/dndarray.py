@@ -322,6 +322,34 @@ class DNDarray:
             out.append(np.asarray(s.data[tuple(idx)]))
         return out
 
+    def ranked_shards(self):
+        """Yield ``(rank, block)`` for every shard THIS process addresses, in
+        mesh-rank order; each block is the shard's **logical** extent as a
+        host numpy array (physical split-axis padding trimmed — pad+mask
+        contract). Ragged-tail ranks whose logical count is zero are skipped;
+        a replicated / 0-d array yields the single pair ``(0, full array)``.
+
+        This is the shard/stream protocol shared by the streaming file
+        writers (``core/io.py`` — HDF5 hyperslabs, CSV rows, npy buffers) and
+        the sharded checkpoint writer (``utils/checkpoint.py``): one host
+        transfer per block, never a global gather. Forces a pending recorded
+        chain (see :attr:`parray`)."""
+        split = self.__split
+        if split is None or self.ndim == 0:
+            yield 0, np.asarray(self.larray)  # local payload, not a gather
+            return
+        counts, _ = self.__comm.counts_displs_shape(self.__gshape, split)
+        phys = self.parray
+        block = int(phys.shape[split]) // self.__comm.size
+        shards = sorted(phys.addressable_shards, key=lambda s: s.index[split].start or 0)
+        for s in shards:
+            r = (s.index[split].start or 0) // block if block else 0
+            c = counts[r]
+            if c:
+                idx = [slice(None)] * self.ndim
+                idx[split] = slice(0, c)
+                yield r, np.asarray(s.data[tuple(idx)])
+
     @property
     def lshape(self) -> Tuple[int, ...]:
         """Logical shape of this process's representative device shard

@@ -274,17 +274,18 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
 
 def _rank_ordered_blocks(data: DNDarray):
     """Yield ``(rank, trimmed_block)`` for every addressable shard of a SPLIT
-    array, in rank order — the shard/trim protocol shared by every streaming
-    writer (HDF5 hyperslabs, CSV rows, npy buffers): each physical shard is
-    cut back to its logical extent (pad+mask contract) and handed over one
-    host transfer at a time, never a global gather.
+    array, in rank order — ``DNDarray.ranked_shards`` (the shard/trim
+    protocol shared with the checkpoint writer) behind the single-file
+    writers' multi-controller guard.
 
     Multi-controller guard: when this process cannot address every mesh
     device, streaming the addressable shards would publish a file whose
     header declares the global shape but whose payload holds only this
     host's blocks — refuse loudly instead of writing a short file. (The
     atomic-publication seam, ``multihost.io_owner``, is still correct for
-    replicated operands: every controller holds the full copy.)"""
+    replicated operands: every controller holds the full copy. The sharded
+    checkpoint writer, ``utils/checkpoint.py``, has no such guard — per-host
+    shard FILES are exactly the remedy this refusal names.)"""
     from .multihost import is_addressable, process_index
 
     proc = process_index()
@@ -292,21 +293,10 @@ def _rank_ordered_blocks(data: DNDarray):
         raise NotImplementedError(
             "streaming save of a split array under a multi-controller mesh: "
             "this process addresses only part of the array, so a single-file "
-            "write would be incomplete. Gather first (resplit_(None)) or save "
-            "per-host files."
+            "write would be incomplete. Gather first (resplit_(None)), save "
+            "per-host files, or use ht.checkpoint (per-host shard files)."
         )
-    split = data.split
-    counts, _ = data.comm.counts_displs_shape(data.shape, split)
-    phys = data.parray
-    block = int(phys.shape[split]) // data.comm.size
-    shards = sorted(phys.addressable_shards, key=lambda s: s.index[split].start or 0)
-    for s in shards:
-        r = (s.index[split].start or 0) // block if block else 0
-        c = counts[r]
-        if c:
-            idx = [slice(None)] * data.ndim
-            idx[split] = slice(0, c)
-            yield r, np.asarray(s.data[tuple(idx)])
+    yield from data.ranked_shards()
 
 
 def _write_h5_dataset(handle, dataset: str, data: DNDarray, **kwargs):

@@ -26,6 +26,11 @@ Contract (documented in doc/internals_distribution.md):
   publishing an atomic file write (``resilience.atomic_write``): exactly
   one process may win the rename when every controller runs the same
   ``save_*`` call.
+* ``process_count()`` / ``sync_processes(tag)`` — how many controllers the
+  runtime has, and a named barrier across them. The checkpoint subsystem
+  (``utils/checkpoint.py``) syncs after every host has published its shard
+  files and before the owner hashes them into the manifest, so the commit
+  point never references files still in flight.
 """
 
 from __future__ import annotations
@@ -36,10 +41,12 @@ import jax
 
 __all__ = [
     "process_index",
+    "process_count",
     "io_owner",
     "is_addressable",
     "ranks_to_read",
     "representative_rank",
+    "sync_processes",
 ]
 
 
@@ -50,6 +57,31 @@ def process_index() -> int:
         return int(jax.process_index())
     except Exception:  # pragma: no cover - backend-dependent
         return 0
+
+
+def process_count() -> int:
+    """How many controller processes the runtime has; 1 when the backend has
+    no notion of processes (single host, or an unstarted distributed
+    runtime)."""
+    try:
+        return int(jax.process_count())
+    except Exception:  # pragma: no cover - backend-dependent
+        return 1
+
+
+def sync_processes(tag: str) -> None:
+    """Named barrier across controller processes (no-op on a single host).
+
+    Cooperative multi-file protocols (the sharded checkpoint writer) need
+    one ordering guarantee the per-file atomic renames cannot give: every
+    host's files are on the shared filesystem before the owner publishes the
+    manifest that references them. ``tag`` names the barrier so mismatched
+    call sites fail loudly instead of deadlocking silently."""
+    if process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils  # pragma: no cover - multi-host only
+
+    multihost_utils.sync_global_devices(tag)  # pragma: no cover - multi-host only
 
 
 def io_owner(proc: int | None = None) -> bool:

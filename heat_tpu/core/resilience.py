@@ -30,6 +30,15 @@ site                  where it fires
 ``io.read``           each per-device block read of the sharded ingest
 ``io.write``          each (whole-file) write attempt of a ``save_*``
 ``io.rename``         the temp-then-rename publication step
+``checkpoint.write``  each per-leaf / per-shard payload-file write of a
+                      checkpoint save (``utils/checkpoint.py``)
+``checkpoint.commit`` the manifest publication — the checkpoint's single
+                      commit point (rides ``atomic_write``)
+``checkpoint.restore``  manifest/payload reads during checkpoint
+                      verification and restore
+``checkpoint.gc``     each retention / debris deletion of checkpoint GC
+                      (failures degrade to a warning; debris waits for the
+                      next sweep)
 ====================  =====================================================
 
 :func:`inject` arms a site from a test or an experiment::
@@ -245,7 +254,15 @@ _PRESETS = {
         "fusion.execute:every=11,"
         "fusion.record:every=17,"
         "io.write:exc=OSError:every=5,"
-        "io.read:exc=OSError:every=7"
+        "io.read:exc=OSError:every=7,"
+        # the checkpoint seams are recoverable by design: write/commit/
+        # restore attempts retry transient OSErrors (call_with_retries), and
+        # a failed GC deletion degrades to a warning + debris for the next
+        # sweep — the kill-mid-save suite must stay green under this mix
+        "checkpoint.write:exc=OSError:every=3,"
+        "checkpoint.commit:exc=OSError:every=3,"
+        "checkpoint.restore:exc=OSError:every=5,"
+        "checkpoint.gc:exc=OSError:every=2"
     ),
 }
 

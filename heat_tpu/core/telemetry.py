@@ -67,6 +67,7 @@ from typing import Any, Dict, List, Optional
 __all__ = [
     "RetraceWarning",
     "active",
+    "checkpoint_events",
     "collective_budget_excess",
     "collective_counts",
     "collectives",
@@ -84,6 +85,7 @@ __all__ = [
     "nonfinite_counts",
     "on_timer",
     "operand_bytes",
+    "record_checkpoint",
     "record_collective",
     "record_collective_operand",
     "record_compile",
@@ -183,6 +185,7 @@ _DEGRADED: Dict[str, Dict[str, Any]] = {}
 _UNFUSED: Dict[str, Dict[str, int]] = {}
 _NONFINITE: Dict[str, int] = {}
 _IO_RETRIES: Dict[str, int] = {}
+_CHECKPOINT: Dict[str, int] = {}
 _EVENTS: deque = deque(maxlen=_EVENT_CAP)
 
 _TRIGGER_STACK: List[str] = []
@@ -201,6 +204,7 @@ def reset() -> None:
     _UNFUSED.clear()
     _NONFINITE.clear()
     _IO_RETRIES.clear()
+    _CHECKPOINT.clear()
     _EVENTS.clear()
     _SPANS.clear()
 
@@ -543,6 +547,25 @@ def io_retries() -> Dict[str, int]:
     return dict(_IO_RETRIES)
 
 
+def record_checkpoint(event: str, step: Optional[int] = None, detail: str = "") -> None:
+    """Count one checkpoint lifecycle event (``utils/checkpoint.py``):
+    ``save`` (manifest committed), ``restore`` (verified restore completed),
+    ``corrupt`` (a checkpoint failed verification), ``fallback`` (restore
+    skipped unverifiable newer checkpoints), ``gc`` (retention/debris sweep
+    removed something). The assertable surface the checkpoint suite pins."""
+    if not _MODE:
+        return
+    _CHECKPOINT[event] = _CHECKPOINT.get(event, 0) + 1
+    if _MODE >= 2:
+        _EVENTS.append({"kind": "checkpoint", "event": event, "step": step, "detail": detail})
+
+
+def checkpoint_events() -> Dict[str, int]:
+    """Per-event checkpoint lifecycle counts (``save``/``restore``/
+    ``corrupt``/``fallback``/``gc``)."""
+    return dict(_CHECKPOINT)
+
+
 # ----------------------------------------------------------------------
 # spans
 # ----------------------------------------------------------------------
@@ -649,6 +672,7 @@ def report() -> Dict[str, Any]:
         "degraded": degraded(),
         "nonfinite": nonfinite_counts(),
         "io_retries": io_retries(),
+        "checkpoint": checkpoint_events(),
         "jit_compiles": dict(_COMPILES),
         "spans": spans(),
     }

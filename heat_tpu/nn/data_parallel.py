@@ -204,16 +204,28 @@ class DataParallel:
         return self
 
     def save(self, directory: str, step: int = 0, keep: int = 3) -> str:
-        """Write ``directory/ckpt_{step}.msgpack`` (atomic; keeps newest ``keep``)."""
+        """Write a manifest-based checkpoint ``directory/ckpt_{step}.manifest.json``
+        (+ per-leaf payload files; the manifest rename is the commit point —
+        a crash never leaves a torn checkpoint). Keeps the newest ``keep``."""
         from ..utils.checkpoint import save_checkpoint
 
         return save_checkpoint(directory, self.state_dict(), step=step, keep=keep)
 
-    def restore(self, directory: str, step: Optional[int] = None) -> "DataParallel":
-        """Resume from a checkpoint written by :meth:`save` (newest by default)."""
+    def restore(
+        self, directory: str, step: Optional[int] = None, strict: bool = False
+    ) -> "DataParallel":
+        """Resume from a checkpoint written by :meth:`save`.
+
+        ``step=None`` restores the newest checkpoint that *verifies*
+        (checksum-checked; unverifiable newer ones are skipped with a
+        warning — ``strict=True`` raises instead). An explicit ``step`` that
+        does not exist on disk raises ``FileNotFoundError`` listing the
+        available steps rather than silently loading the newest."""
         from ..utils.checkpoint import load_checkpoint
 
-        return self.load_state_dict(load_checkpoint(directory, self.state_dict(), step=step))
+        return self.load_state_dict(
+            load_checkpoint(directory, self.state_dict(), step=step, strict=strict)
+        )
 
 
 class DataParallelMultiGPU(DataParallel):
@@ -266,8 +278,8 @@ class DataParallelMultiGPU(DataParallel):
             return self.daso.save(directory, step=step, keep=keep)
         return super().save(directory, step=step, keep=keep)
 
-    def restore(self, directory: str, step: Optional[int] = None):
+    def restore(self, directory: str, step: Optional[int] = None, strict: bool = False):
         if self.daso is not None:
-            self.daso.restore(directory, step=step)
+            self.daso.restore(directory, step=step, strict=strict)
             return self
-        return super().restore(directory, step=step)
+        return super().restore(directory, step=step, strict=strict)

@@ -462,13 +462,24 @@ class DASO:
         return self
 
     def save(self, directory: str, step: int = 0, keep: int = 3) -> str:
-        """Write ``directory/ckpt_{step}.msgpack`` (atomic; keeps newest ``keep``)."""
+        """Write a manifest-based checkpoint ``directory/ckpt_{step}.manifest.json``
+        (+ per-leaf payload files; the manifest rename is the commit point —
+        a kill mid-save leaves the previous checkpoint restorable, never a
+        torn hybrid). Keeps the newest ``keep``."""
         from ..utils.checkpoint import save_checkpoint
 
         return save_checkpoint(directory, self.state_dict(), step=step, keep=keep)
 
-    def restore(self, directory: str, step=None) -> "DASO":
-        """Resume from a checkpoint written by :meth:`save` (newest by default)."""
+    def restore(self, directory: str, step=None, strict: bool = False) -> "DASO":
+        """Resume from a checkpoint written by :meth:`save`.
+
+        ``step=None`` restores the newest checkpoint that *verifies*
+        (checksum-checked; a torn/corrupt newest is skipped with a warning —
+        ``strict=True`` raises instead). An explicit ``step`` that does not
+        exist on disk raises ``FileNotFoundError`` listing the available
+        steps rather than silently loading the newest."""
         from ..utils.checkpoint import load_checkpoint
 
-        return self.load_state_dict(load_checkpoint(directory, self.state_dict(), step=step))
+        return self.load_state_dict(
+            load_checkpoint(directory, self.state_dict(), step=step, strict=strict)
+        )

@@ -1,132 +1,1179 @@
-"""Checkpoint / resume subsystem.
+"""Verified, sharded, crash-consistent checkpoint / resume subsystem.
 
 The reference checkpoints *data* only (``ht.save``/``ht.load`` to
 HDF5/NetCDF/CSV, reference io.py:149-227); it has **no** model/optimizer
 checkpointing — DASO's ``DetectMetricPlateau`` exposes get_state/set_state
-dicts that nothing serializes (reference optim/utils.py:72-108, SURVEY.md §5).
-This module closes that gap for the TPU build:
+dicts that nothing serializes (reference optim/utils.py:72-108, SURVEY.md §5)
+and MPI fail-stop means a killed run is a dead run. This module is the TPU
+build's answer, surfaced as ``ht.checkpoint``:
 
-* :func:`save_checkpoint` / :func:`load_checkpoint` — any pytree of arrays to
-  a single msgpack file (flax.serialization), atomically (write tmp + rename),
-  with a retention policy (``keep``) and step-tagged filenames.
-* :func:`latest_step` — discover the newest step in a directory.
-* Trainer integration: ``DataParallel.state_dict/load_state_dict`` and
-  ``DASO.state_dict/load_state_dict`` (params, optimizer state, schedule
-  counters, plateau-controller state) round-trip through these files, so a
-  killed training run resumes exactly — the failure-recovery story MPI
-  fail-stop never had.
+Manifest format
+---------------
+A checkpoint at ``step`` is a JSON **manifest** ``ckpt_<step>.manifest.json``
+plus a **payload directory** of per-leaf files the manifest references:
 
-Arrays come back as numpy; feed them to ``jax.device_put`` / the trainer's
-``load_state_dict`` which re-establishes shardings (single-controller JAX
-re-shards on first use, so a checkpoint written on one mesh shape restores
-onto another — elasticity the reference cannot express).
+* DNDarray leaves are written as **per-host shard files** — one file per
+  mesh rank with a non-empty logical block (``DNDarray.ranked_shards``, the
+  same shard/trim protocol the streaming ``save_*`` writers use), so no host
+  allocation ever equals the global array and no allgather is paid. The
+  manifest records global shape/dtype/split, the mesh size at save time, and
+  each shard file's row range along the split axis.
+* Other array leaves (``jax.Array``/numpy) are written whole as one payload
+  file each (``.npy`` for native dtypes; a raw buffer + recorded dtype name
+  for ml_dtypes extensions like bfloat16, which npy round-trips as void).
+* Plain Python leaves (ints, floats incl. inf/nan, bools, strings, None)
+  are inlined in the manifest.
+
+Every payload file's SHA-256 is recorded in the manifest.
+
+Commit point & crash consistency
+--------------------------------
+Payload files are staged first (each atomically under its own name by the
+one process that writes it); the **manifest rename is the single commit
+point**, routed through ``resilience.atomic_write`` so only the owning
+process (``multihost.io_owner()``) publishes it. A crash at any instant
+leaves either the previous checkpoint or the new one — never a hybrid: an
+uncommitted payload directory is invisible to restore and swept as debris by
+a later save's GC. Overwriting an existing step stages into an alternate
+payload directory (``ckpt_<step>.r1``) so the committed payload is never
+mutated before the new manifest lands.
+
+Verified + elastic restore
+--------------------------
+``load_checkpoint`` verifies the manifest and every payload checksum before
+reconstructing anything. A torn/corrupt/incomplete newest checkpoint emits a
+:class:`CheckpointCorruptWarning`, records ``telemetry`` checkpoint events,
+and **falls back to the newest checkpoint that verifies**; ``strict=True``
+(or an explicit ``step=``) opts out and raises :class:`CheckpointCorruptError`
+naming the path, step, and the fallback decision taken. DNDarray leaves
+restore **elastically**: a checkpoint saved on a p-device mesh reloads onto
+any current mesh by reading each new device's block from the overlapping
+saved shard files (``io._sharded_ingest`` — per-range reads, no global host
+copy), bitwise identical to the saved global array.
+
+Deliberate trade-off: a manifest restore reads payload files twice — one
+full checksum pass to SELECT the step (fallback must decide before any
+reconstruction), then the reconstruction's reads. The passes cannot merge:
+elastic restore reads only this host's ranges, while verification must cover
+whole files. Legacy blobs, whose verify decode IS the restore decode, are
+memoized instead (one read+decode total on the load path); saves hash from
+the write stream, never a readback.
+
+Retention & GC
+--------------
+Keep-N GC is validity-aware: it never deletes the last checkpoint that
+verifies (an unverifiable newest cannot cause a valid older checkpoint to be
+culled), and it sweeps orphaned temp/shard debris — legacy
+``ckpt_*.msgpack.tmp`` files, ``*.tmp-*`` staging files and payload
+directories no committed manifest references — once they are older than the
+newest committed manifest. GC failures degrade to a warning (the save still
+succeeds); the debris waits for the next sweep.
+
+Legacy single-blob ``ckpt_<step>.msgpack`` files (flax.serialization) remain
+loadable behind the same error surface: a truncated/corrupt blob raises
+:class:`CheckpointCorruptError` (or falls back) instead of a cryptic flax
+deserialization error.
+
+Fault sites (``core/resilience.py``): ``checkpoint.write`` (payload-file
+attempts), ``checkpoint.commit`` (manifest publication), ``checkpoint.restore``
+(verify/restore reads) — all retried for transient ``OSError``s — and
+``checkpoint.gc`` (each deletion; degrades). All four are in the ``ci``
+ambient preset.
+
+Arrays come back as numpy (DNDarray leaves as DNDarrays on the current
+mesh); feed them to the trainer's ``load_state_dict``, which re-establishes
+shardings.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import re
-from typing import Any, Dict, Optional
+import shutil
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
-from flax import serialization
+
+from ..core import resilience, telemetry
 
 __all__ = [
+    "CheckpointCorruptError",
+    "CheckpointCorruptWarning",
+    "MANIFEST_VERSION",
+    "all_steps",
+    "gc_checkpoints",
     "latest_step",
     "load_checkpoint",
     "save_checkpoint",
+    "verify_checkpoint",
 ]
 
-_FILE_RE = re.compile(r"^ckpt_(\d+)\.msgpack$")
+MANIFEST_VERSION = 1
+_FORMAT_NAME = "heat-tpu-checkpoint"
+
+_MANIFEST_RE = re.compile(r"^ckpt_(\d+)\.manifest\.json$")
+_LEGACY_RE = re.compile(r"^ckpt_(\d+)\.msgpack$")
+_LEGACY_TMP_RE = re.compile(r"^ckpt_(\d+)\.msgpack\.tmp$")
+_PAYLOAD_RE = re.compile(r"^ckpt_(\d+)(\.r\d+)?$")
+
+# restore-time forcing attribution: checkpoint writes are I/O
+_T_IO = telemetry.force_trigger("io")
 
 
-def _to_host(tree: Any) -> Any:
-    """Device arrays -> numpy (gathers sharded jax.Arrays to host).
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed verification (torn payload, checksum mismatch,
+    truncated legacy msgpack) and the configured policy forbids — or could
+    not find — a fallback. The message names the path, the step, and the
+    fallback decision taken."""
 
-    Arrays spanning non-addressable devices (multi-host meshes) cannot be
-    read with ``np.asarray``; those are allgathered across processes first.
-    """
 
+class CheckpointCorruptWarning(UserWarning):
+    """Restore skipped one or more unverifiable checkpoints and fell back to
+    the newest one that verifies."""
+
+
+# ----------------------------------------------------------------------
+# small helpers
+# ----------------------------------------------------------------------
+def _proc() -> int:
+    from ..core import multihost
+
+    return multihost.process_index()
+
+
+# the same cleanup primitive atomic_write uses — one definition to drift
+_unlink_quiet = resilience._unlink_quiet
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a recorded dtype name, including ml_dtypes extensions
+    (``bfloat16``/``float8_*``) numpy alone cannot name."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _is_native_npy_dtype(dtype: np.dtype) -> bool:
+    """Whether ``.npy`` round-trips this dtype faithfully. ml_dtypes
+    extensions (kind 'V' descrs) load back as void — those take the raw
+    format with the dtype name recorded in the manifest."""
+    return dtype.kind in "biufc" and dtype.names is None
+
+
+def _check_serializable_dtype(dtype: np.dtype, where: str) -> None:
+    """Refuse at SAVE time any dtype restore could not round-trip: the raw
+    fallback can write unicode/object/datetime buffers that checksum cleanly
+    but are unrestorable (``_np_dtype`` cannot resolve the name; object
+    arrays would serialize raw pointers) — a 'verified' checkpoint that is
+    silent data loss. Mirrors ``_encode_py``'s reject-unknown stance."""
+    if _is_native_npy_dtype(dtype):
+        return
+    try:
+        # the raw format is ONLY for ml_dtypes extensions; np.dtype(name)
+        # would happily "resolve" object/unicode/datetime names too
+        import ml_dtypes
+
+        ok = np.dtype(getattr(ml_dtypes, dtype.name)) == dtype
+    except Exception:  # noqa: BLE001 - unresolvable name = not serializable
+        ok = False
+    if not ok:
+        raise TypeError(
+            f"checkpoint leaf {where!r} has dtype {dtype!r}, which no restore "
+            "could round-trip (supported: bool/int/uint/float/complex and "
+            "ml_dtypes extensions like bfloat16)"
+        )
+
+
+def _sha256_file(path: str, site: str = "checkpoint.restore") -> str:
+    """Streaming SHA-256 of ``path`` (1 MiB chunks — never the whole file in
+    memory); the read is retried like any other block read."""
+
+    def _hash() -> str:
+        h = hashlib.sha256()
+        with open(path, "rb") as fh:
+            while True:
+                chunk = fh.read(1 << 20)
+                if not chunk:
+                    break
+                h.update(chunk)
+        return h.hexdigest()
+
+    return resilience.call_with_retries(site, _hash)
+
+
+def _to_host_array(x) -> np.ndarray:
+    """Device array -> host numpy; arrays spanning non-addressable devices
+    (multi-host meshes) are allgathered across processes first."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils  # pragma: no cover - multi-host
+
+        x = multihost_utils.process_allgather(x, tiled=True)  # pragma: no cover
+    return np.asarray(x)
+
+
+def _is_arraylike(x) -> bool:
+    return hasattr(x, "dtype") or hasattr(x, "__array__")
+
+
+def _encode_py(v):
+    """JSON-safe encoding of a plain Python leaf (nan/inf floats included —
+    the plateau controller's ``best``/``mode_worse`` start at inf)."""
+    if isinstance(v, float):
+        if np.isfinite(v):
+            return v
+        return {"__nonfinite__": repr(v)}
+    if v is None or isinstance(v, (bool, int, str)):
+        return v
+    raise TypeError(
+        f"checkpoint leaf of type {type(v).__name__} is not serializable "
+        "(arrays, DNDarrays, and plain Python scalars/strings are)"
+    )
+
+
+def _decode_py(v):
+    if isinstance(v, dict) and "__nonfinite__" in v:
+        return float(v["__nonfinite__"])
+    return v
+
+
+def _flatten_with_paths(tree) -> Tuple[List[str], List[Any], Any]:
+    """Flatten ``tree`` with DNDarrays as leaves; path strings key the
+    manifest entries so save/restore match by structure, not by position."""
     from ..core.dndarray import DNDarray
 
-    def to_np(x):
-        if isinstance(x, DNDarray):
-            # a DNDarray serializes as its LOGICAL global array (not the
-            # padded physical payload its pytree leaf carries); falling
-            # through to the jax.Array handling keeps the multi-host
-            # allgather path below
-            x = x.larray
-        if not (hasattr(x, "dtype") or hasattr(x, "__array__")):
-            return x
-        if isinstance(x, jax.Array) and not x.is_fully_addressable:
-            from jax.experimental import multihost_utils
-
-            x = multihost_utils.process_allgather(x, tiled=True)
-        return np.asarray(x)
-
-    return jax.tree.map(to_np, tree, is_leaf=lambda x: isinstance(x, DNDarray))
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(
+        tree, is_leaf=lambda x: isinstance(x, DNDarray)
+    )
+    paths = [jax.tree_util.keystr(p) for p, _ in leaves_with_paths]
+    return paths, [leaf for _, leaf in leaves_with_paths], treedef
 
 
-def save_checkpoint(directory: str, tree: Any, step: int = 0, keep: int = 3) -> str:
-    """Serialize ``tree`` to ``directory/ckpt_{step}.msgpack`` atomically.
-
-    Older step files beyond the newest ``keep`` are deleted (``keep <= 0``
-    keeps everything). Returns the written path.
-    """
-    os.makedirs(directory, exist_ok=True)
-    payload = serialization.to_bytes(_to_host(tree))
-    path = os.path.join(directory, f"ckpt_{int(step)}.msgpack")
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(payload)
-    os.replace(tmp, path)  # atomic on POSIX: no torn checkpoints on crash
-    if keep > 0:
-        steps = _all_steps(directory)
-        for old in steps[:-keep]:
-            if old == int(step):
-                # never cull the checkpoint just written (e.g. a resumed run
-                # whose step counter restarted below existing step tags)
-                continue
-            try:
-                os.remove(os.path.join(directory, f"ckpt_{old}.msgpack"))
-            except OSError:  # pragma: no cover - concurrent cleanup
-                pass
-    return path
-
-
-def _all_steps(directory: str):
-    steps = []
+# ----------------------------------------------------------------------
+# directory enumeration
+# ----------------------------------------------------------------------
+def _committed(directory: str) -> Dict[int, str]:
+    """step -> committed artifact name (manifest preferred over a legacy
+    blob carrying the same step tag)."""
+    out: Dict[int, str] = {}
     try:
-        for name in os.listdir(directory):
-            m = _FILE_RE.match(name)
-            if m:
-                steps.append(int(m.group(1)))
+        names = os.listdir(directory)
     except FileNotFoundError:
-        pass
-    return sorted(steps)
+        return out
+    for name in names:
+        m = _LEGACY_RE.match(name)
+        if m:
+            out.setdefault(int(m.group(1)), name)
+    for name in names:
+        m = _MANIFEST_RE.match(name)
+        if m:
+            out[int(m.group(1))] = name  # manifest wins
+    return out
+
+
+def _all_steps(directory: str) -> List[int]:
+    return sorted(_committed(directory))
+
+
+def all_steps(directory: str) -> List[int]:
+    """Every committed checkpoint step in ``directory`` (manifest-based and
+    legacy msgpack), sorted ascending. Commitment, not validity: a step may
+    still fail :func:`verify_checkpoint`."""
+    return _all_steps(directory)
 
 
 def latest_step(directory: str) -> Optional[int]:
-    """Newest checkpointed step in ``directory``, or None."""
+    """Newest committed step in ``directory``, or None."""
     steps = _all_steps(directory)
     return steps[-1] if steps else None
 
 
-def load_checkpoint(directory: str, target: Any, step: Optional[int] = None) -> Any:
+def _manifest_path(directory: str, step: int) -> str:
+    return os.path.join(directory, f"ckpt_{int(step)}.manifest.json")
+
+
+def _legacy_path(directory: str, step: int) -> str:
+    return os.path.join(directory, f"ckpt_{int(step)}.msgpack")
+
+
+def _read_manifest(directory: str, step: int) -> dict:
+    path = _manifest_path(directory, step)
+
+    def _read():
+        with open(path, "r") as fh:
+            return json.load(fh)
+
+    return resilience.call_with_retries("checkpoint.restore", _read)
+
+
+# ----------------------------------------------------------------------
+# payload writers
+# ----------------------------------------------------------------------
+class _HashingWriter:
+    """File-like pass-through that SHA-256-hashes every byte it writes, so
+    the writer's checksum comes from the write stream itself — no readback
+    of a file whose bytes are still in memory. (No ``fileno``: numpy then
+    takes its buffered ``write()`` path instead of bypassing via tofile.)"""
+
+    __slots__ = ("fh", "h", "n")
+
+    def __init__(self, fh):
+        self.fh = fh
+        self.h = hashlib.sha256()
+        self.n = 0
+
+    def write(self, b) -> int:
+        self.h.update(b)
+        self.n += len(b)
+        return self.fh.write(b)
+
+
+def _write_payload_file(path: str, arr: np.ndarray) -> Tuple[str, int]:
+    """Write one payload file atomically under ITS OWN name: private temp,
+    then a rename by the (single) process writing it. Not
+    ``resilience.atomic_write`` — that gates the rename on ``io_owner()``,
+    which is correct for a path every controller writes cooperatively but
+    wrong here, where each shard file has exactly one writer. Transient
+    ``OSError``s re-run the whole attempt (``checkpoint.write`` site).
+    Returns ``(sha256_hex, nbytes)`` of the published file, hashed from the
+    write stream."""
+    # np.asarray, NOT ascontiguousarray: the latter promotes 0-d scalars to
+    # 1-d, corrupting the recorded shape; np.save/tobytes copy as needed
+    arr = np.asarray(arr)
+    native = _is_native_npy_dtype(arr.dtype)
+
+    def _attempt() -> Tuple[str, int]:
+        tmp = f"{path}.tmp-{os.getpid()}-{_proc()}"
+        try:
+            with open(tmp, "wb") as fh:
+                w = _HashingWriter(fh)
+                if native:
+                    np.save(w, arr)
+                else:
+                    w.write(arr.tobytes())
+            os.replace(tmp, path)
+            return w.h.hexdigest(), w.n
+        except BaseException:
+            _unlink_quiet(tmp)
+            raise
+
+    return resilience.call_with_retries("checkpoint.write", _attempt)
+
+
+def _file_entry(payload_rel: str, fname: str, dtype: np.dtype, shape: Tuple[int, ...]) -> dict:
+    return {
+        "file": f"{payload_rel}/{fname}",
+        "format": "npy" if _is_native_npy_dtype(dtype) else "raw",
+        "dtype": dtype.name,
+        "shape": [int(s) for s in shape],
+        # filled by the WRITER from its hash-on-write stream; the owner only
+        # hashes files other hosts published (after the barrier)
+        "sha256": None,
+        "bytes": None,
+    }
+
+
+def _save_dndarray(payload_dir: str, payload_rel: str, base: str, leaf, host_arr) -> dict:
+    """Write a DNDarray leaf as per-host shard files; return its manifest
+    entry. The writer fills each shard's checksum from its own write stream;
+    shards published by OTHER hosts stay ``sha256: None`` for the owner to
+    hash after the barrier. ``host_arr`` is the pre-materialized host copy
+    for the replicated/0-d branch (materialization may be collective and
+    happens in the save's phase-1, before any deferred-error file I/O)."""
+    split = leaf.split
+    dtype = np.dtype(leaf.dtype.jax_type())
+    _check_serializable_dtype(dtype, base)
+    entry: dict = {
+        "kind": "dndarray",
+        "gshape": [int(s) for s in leaf.shape],
+        "dtype": dtype.name,
+        "split": None if split is None else int(split),
+        "mesh_size": int(leaf.comm.size),
+        "files": [],
+    }
+    if split is None or leaf.ndim == 0:
+        fname = f"{base}.shard_full"
+        frag = _file_entry(payload_rel, fname, dtype, leaf.shape)
+        frag["rank"] = None
+        if _from_owner():  # a replicated value has one writer
+            frag["sha256"], frag["bytes"] = _write_payload_file(
+                os.path.join(payload_dir, fname), host_arr
+            )
+        entry["files"].append(frag)
+        return entry
+    counts, displs = leaf.comm.counts_displs_shape(leaf.shape, split)
+    # the file LIST covers every rank with a non-empty logical block (other
+    # hosts write theirs); the shapes are deterministic block arithmetic
+    frag_by_rank = {}
+    for r in range(leaf.comm.size):
+        if counts[r]:
+            bshape = list(leaf.shape)
+            bshape[split] = counts[r]
+            frag = _file_entry(payload_rel, f"{base}.shard_{r:05d}", dtype, bshape)
+            frag["rank"] = r
+            frag["start"] = int(displs[r])
+            frag["stop"] = int(displs[r] + counts[r])
+            frag_by_rank[r] = frag
+            entry["files"].append(frag)
+    with _T_IO:
+        for rank, block in leaf.ranked_shards():
+            frag = frag_by_rank[rank]
+            frag["sha256"], frag["bytes"] = _write_payload_file(
+                os.path.join(payload_dir, f"{base}.shard_{rank:05d}"), block
+            )
+    return entry
+
+
+def _from_owner() -> bool:
+    from ..core import multihost
+
+    return multihost.io_owner()
+
+
+def _payload_rel_for_save(directory: str, step: int) -> str:
+    """Staging directory name for a save of ``step`` — deterministic across
+    cooperating controller processes (it depends only on the COMMITTED
+    manifest, never on scan-time debris): the default ``ckpt_<step>``, or
+    ``ckpt_<step>.r1`` when a committed manifest for the same step already
+    references the default — the committed payload is never written into
+    before the new manifest lands (no torn hybrid on overwrite-same-step)."""
+    base = f"ckpt_{int(step)}"
+    if os.path.exists(_manifest_path(directory, step)):
+        try:
+            current = _read_manifest(directory, step).get("payload")
+        except Exception:  # noqa: BLE001
+            # the committed manifest is unreadable RIGHT NOW (transient blip
+            # or torn) — it could reference base OR any .rN, so stage into a
+            # name that does not exist on disk at all: the committed payload,
+            # whichever it is, is never written into
+            cand, k = base, 0
+            while os.path.exists(os.path.join(directory, cand)):
+                k += 1
+                cand = f"{base}.r{k}"
+            return cand
+        if current == base:
+            return base + ".r1"
+    return base
+
+
+# ----------------------------------------------------------------------
+# save
+# ----------------------------------------------------------------------
+def save_checkpoint(directory: str, tree: Any, step: int = 0, keep: int = 3) -> str:
+    """Serialize ``tree`` to a manifest-based checkpoint in ``directory``.
+
+    Stages per-leaf payload files (DNDarray leaves as per-host shard files —
+    no global gather), then publishes ``ckpt_<step>.manifest.json`` with
+    per-file SHA-256 checksums via ``resilience.atomic_write`` — the single
+    commit point. Keep-N retention plus a debris sweep run after the commit
+    (``keep <= 0`` keeps everything; GC failures degrade to a warning).
+    Returns the manifest path.
+    """
+    from ..core import multihost
+    from ..core.dndarray import DNDarray
+
+    step = int(step)
+    os.makedirs(directory, exist_ok=True)
+    payload_rel = _payload_rel_for_save(directory, step)
+    payload_dir = os.path.join(directory, payload_rel)
+    os.makedirs(payload_dir, exist_ok=True)
+    if multihost.process_count() > 1:  # pragma: no cover - multi-host only
+        # drop this host's receipt from any previous crashed attempt FIRST:
+        # only checksums published THIS attempt may reach the manifest
+        _unlink_quiet(
+            os.path.join(payload_dir, f".receipt-{multihost.process_index()}.json")
+        )
+
+    paths, leaves, _ = _flatten_with_paths(tree)
+    owner = multihost.io_owner()
+    # phase 1 — MATERIALIZE: everything that may launch a collective
+    # (forcing a pending fused chain, allgathering a non-addressable array)
+    # runs here, synchronously on every controller, BEFORE any deferred-error
+    # file I/O: collective failures surface symmetrically on all hosts, so no
+    # host diverges into a collective its peers abandoned mid-loop.
+    host_arrays: Dict[int, np.ndarray] = {}
+    for i, (pkey, leaf) in enumerate(zip(paths, leaves)):
+        if isinstance(leaf, DNDarray):
+            if leaf.split is None or leaf.ndim == 0:
+                with _T_IO:
+                    host_arrays[i] = _to_host_array(leaf.larray)
+            else:
+                with _T_IO:
+                    leaf.parray  # force any pending chain; shard reads stay local
+        elif _is_arraylike(leaf):
+            arr = _to_host_array(leaf)
+            _check_serializable_dtype(arr.dtype, pkey)
+            host_arrays[i] = arr
+        else:
+            _encode_py(leaf)  # unserializable-leaf errors raise symmetrically
+
+    # phase 2 — WRITE (local file I/O only). A local failure here must NOT
+    # skip the barriers below: the other controllers are (or will be) parked
+    # in sync_processes with no timeout, and an early raise would hang the
+    # cluster on exactly the flaky-mount failure this subsystem exists to
+    # survive. So each phase records its error, every process hits both
+    # barriers exactly once, and the error re-raises after. (Scope: a
+    # NON-owner cannot learn the owner's commit failed — same
+    # no-completion-signal contract as resilience.atomic_write; check
+    # latest_step() when that matters.)
+    err: Optional[BaseException] = None
+    entries: List[dict] = []
+    try:
+        for i, (pkey, leaf) in enumerate(zip(paths, leaves)):
+            base = f"leaf_{i:05d}"
+            if isinstance(leaf, DNDarray):
+                entry = _save_dndarray(payload_dir, payload_rel, base, leaf, host_arrays.get(i))
+            elif _is_arraylike(leaf):
+                arr = host_arrays[i]
+                fname = f"{base}.arr"
+                frag = _file_entry(payload_rel, fname, arr.dtype, arr.shape)
+                if owner:  # replicated value: one writer suffices
+                    frag["sha256"], frag["bytes"] = _write_payload_file(
+                        os.path.join(payload_dir, fname), arr
+                    )
+                entry = {"kind": "array", "files": [frag]}
+            else:
+                entry = {"kind": "py", "value": _encode_py(leaf)}
+            entry["path"] = pkey
+            entries.append(entry)
+    except BaseException as exc:  # noqa: BLE001 - re-raised after the barriers
+        err = exc
+
+    # multi-controller only: each host publishes a RECEIPT of the shard
+    # checksums it wrote THIS attempt. The owner fills peer frags from
+    # receipts, never by hashing whatever file sits at the path — a host
+    # whose writes failed produces no receipt, so a stale same-name shard
+    # left by a previous crashed attempt can never be checksummed into a
+    # "verified" hybrid manifest.
+    if err is None and multihost.process_count() > 1:  # pragma: no cover - multi-host
+        try:
+            receipt = {
+                frag["file"]: [frag["sha256"], frag["bytes"]]
+                for entry in entries
+                for frag in entry.get("files", ())
+                if frag["sha256"] is not None
+            }
+            rpath = os.path.join(payload_dir, f".receipt-{multihost.process_index()}.json")
+
+            def _publish_receipt():
+                tmp = f"{rpath}.tmp-{os.getpid()}-{_proc()}"
+                try:
+                    with open(tmp, "w") as fh:
+                        json.dump(receipt, fh)
+                    os.replace(tmp, rpath)
+                except BaseException:
+                    _unlink_quiet(tmp)
+                    raise
+
+            resilience.call_with_retries("checkpoint.write", _publish_receipt)
+        except BaseException as exc:  # noqa: BLE001 - re-raised after the barriers
+            err = exc
+
+    # every host's shard files (and receipts) must be on the (shared)
+    # filesystem before the owner builds the manifest it is about to publish
+    multihost.sync_processes(f"heat_tpu.checkpoint.save.{step}")
+
+    manifest_path = _manifest_path(directory, step)
+    if owner and err is None:
+        try:
+            needed = [
+                frag
+                for entry in entries
+                for frag in entry.get("files", ())
+                if frag["sha256"] is None  # written (or not) by another host
+            ]
+            if needed:  # pragma: no cover - multi-host only
+                peer_receipts: Dict[str, list] = {}
+                for p in range(multihost.process_count()):
+                    rpath = os.path.join(payload_dir, f".receipt-{p}.json")
+
+                    def _read_receipt(rp=rpath):
+                        with open(rp) as fh:
+                            return json.load(fh)
+
+                    try:
+                        peer_receipts.update(
+                            resilience.call_with_retries("checkpoint.restore", _read_receipt)
+                        )
+                    except FileNotFoundError:
+                        pass  # that host failed its writes: its frags stay unfilled
+                for frag in needed:
+                    if frag["file"] not in peer_receipts:
+                        raise RuntimeError(
+                            f"shard {frag['file']} was never published this attempt "
+                            "(a peer controller's write failed) — refusing to commit "
+                            "a manifest referencing stale bytes"
+                        )
+                    frag["sha256"], frag["bytes"] = peer_receipts[frag["file"]]
+            doc = {
+                "format": _FORMAT_NAME,
+                "version": MANIFEST_VERSION,
+                "step": step,
+                "payload": payload_rel,
+                "leaves": entries,
+            }
+
+            def _commit():
+                with resilience.atomic_write(manifest_path) as tmp:
+                    with open(tmp, "w") as fh:
+                        json.dump(doc, fh, indent=1)
+                        fh.write("\n")
+
+            resilience.call_with_retries("checkpoint.commit", _commit)
+            telemetry.record_checkpoint("save", step)
+        except BaseException as exc:  # noqa: BLE001 - re-raised after the barrier
+            err = exc
+    # non-owners wait for the commit so no controller returns (and possibly
+    # restores) before the manifest exists
+    multihost.sync_processes(f"heat_tpu.checkpoint.commit.{step}")
+    if err is not None:
+        raise err
+    gc_checkpoints(directory, keep=keep, protect_step=step)
+    return manifest_path
+
+
+# ----------------------------------------------------------------------
+# verification
+# ----------------------------------------------------------------------
+def verify_checkpoint(directory: str, step: int) -> List[str]:
+    """Verify the committed checkpoint for ``step``; returns the list of
+    problems (empty == the checkpoint verifies).
+
+    Manifest checkpoints: the manifest must parse, every referenced payload
+    file must exist with a matching size and SHA-256. Legacy msgpack blobs:
+    the msgpack stream must decode (truncation is the crash signature).
+    """
+    step = int(step)
+    return _verify_step(directory, step)
+
+
+def _verify_step(directory: str, step: int, keep_probe: bool = False) -> List[str]:
+    if os.path.exists(_manifest_path(directory, step)):
+        return _verify_manifest_artifact(directory, step)
+    if os.path.exists(_legacy_path(directory, step)):
+        return _verify_legacy_artifact(directory, step, keep_probe=keep_probe)
+    return [f"no committed checkpoint for step {step}"]
+
+
+def _verify_manifest_artifact(directory: str, step: int) -> List[str]:
+    try:
+        doc = _read_manifest(directory, step)
+    except Exception as exc:  # noqa: BLE001 - any parse failure = torn manifest
+        return [f"manifest unreadable: {exc!r}"]
+    if doc.get("format") != _FORMAT_NAME:
+        return [f"manifest format {doc.get('format')!r} is not {_FORMAT_NAME!r}"]
+    if int(doc.get("version", -1)) > MANIFEST_VERSION:
+        return [f"manifest version {doc.get('version')} is newer than supported {MANIFEST_VERSION}"]
+    problems = []
+    for entry in doc.get("leaves", ()):
+        for frag in entry.get("files", ()):
+            full = os.path.join(directory, frag["file"])
+            try:
+                # one retried stat covers existence AND size: a transient
+                # EIO must ride the same retry/fallback path as the hash
+                # reads, not abort the whole load uncaught
+                size = resilience.call_with_retries(
+                    "checkpoint.restore", os.path.getsize, full
+                )
+            except FileNotFoundError:
+                problems.append(f"missing payload file {frag['file']}")
+                continue
+            except OSError as exc:
+                problems.append(f"payload file {frag['file']} unreadable: {exc!r}")
+                continue
+            if frag.get("bytes") is not None and size != frag["bytes"]:
+                problems.append(
+                    f"payload file {frag['file']} is {size} bytes, "
+                    f"manifest says {frag['bytes']}"
+                )
+                continue
+            try:
+                if frag.get("sha256") and _sha256_file(full) != frag["sha256"]:
+                    problems.append(f"payload file {frag['file']} fails its SHA-256 check")
+            except OSError as exc:
+                problems.append(f"payload file {frag['file']} unreadable: {exc!r}")
+    return problems
+
+
+#: one-slot (path, mtime, size) -> decoded state memo: the LOAD path's verify
+#: already reads and msgpack-decodes the whole legacy blob, so the restore
+#: that follows immediately must not pay the full read+decode a second time.
+#: Only populated with ``keep_probe=True`` (the load path) — a bare public
+#: ``verify_checkpoint()`` or a GC validity scan must not pin a potentially
+#: multi-GB decoded state in module state for the life of the process.
+_LEGACY_PROBE: Optional[Tuple[str, float, int, Any]] = None
+
+
+def _legacy_stat(path: str) -> Tuple[float, int]:
+    st = os.stat(path)
+    return st.st_mtime, st.st_size
+
+
+def _verify_legacy_artifact(directory: str, step: int, keep_probe: bool = False) -> List[str]:
+    from flax import serialization
+
+    global _LEGACY_PROBE
+    lpath = _legacy_path(directory, step)
+
+    def _probe():
+        with open(lpath, "rb") as fh:
+            return fh.read()
+
+    try:
+        stat = _legacy_stat(lpath)
+        state = serialization.msgpack_restore(
+            resilience.call_with_retries("checkpoint.restore", _probe)
+        )
+    except Exception as exc:  # noqa: BLE001 - any decode failure = torn blob
+        _LEGACY_PROBE = None
+        return [f"legacy msgpack undecodable (truncated/corrupt): {exc!r}"]
+    if keep_probe:
+        _LEGACY_PROBE = (lpath, stat[0], stat[1], state)
+    return []
+
+
+# ----------------------------------------------------------------------
+# restore
+# ----------------------------------------------------------------------
+def _read_array_file(directory: str, frag: dict) -> np.ndarray:
+    full = os.path.join(directory, frag["file"])
+    dtype = _np_dtype(frag["dtype"])
+    shape = tuple(frag["shape"])
+
+    def _read():
+        if frag["format"] == "npy":
+            return np.load(full, allow_pickle=False)
+        return np.fromfile(full, dtype=dtype).reshape(shape)
+
+    arr = resilience.call_with_retries("checkpoint.restore", _read)
+    if tuple(arr.shape) != shape:
+        raise CheckpointCorruptError(
+            f"payload file {frag['file']} holds shape {tuple(arr.shape)}, manifest says {shape}"
+        )
+    return arr
+
+
+def _open_array_lazy(directory: str, frag: dict):
+    """Memory-mapped view of a payload file — per-range reads only page in
+    the requested blocks (the elastic-restore path never assembles the
+    global array on the host)."""
+    full = os.path.join(directory, frag["file"])
+    if frag["format"] == "npy":
+        return np.load(full, mmap_mode="r", allow_pickle=False)
+    return np.memmap(full, dtype=_np_dtype(frag["dtype"]), mode="r", shape=tuple(frag["shape"]))
+
+
+def _restore_dndarray(directory: str, entry: dict, template) -> Any:
+    """Elastic DNDarray restore: reshard the saved per-rank shard files onto
+    the CURRENT topology — the template's comm/device AND split (or the
+    default comm with the saved split), bitwise identical to the saved
+    global array. Neither the mesh size nor the split axis needs to match
+    the save-time layout: any requested global block is assembled from the
+    overlapping saved shards' ranges along the SAVED split axis (arxiv
+    2112.01075 frames restore-onto-a-different-mesh as exactly this
+    redistribution problem)."""
+    from ..core import devices as devices_module
+    from ..core import factories, io as io_module, types
+    from ..core.communication import sanitize_comm
+    from ..core.dndarray import DNDarray
+
+    gshape = tuple(int(s) for s in entry["gshape"])
+    saved_split = entry["split"]
+    dtype = types.canonical_heat_type(_np_dtype(entry["dtype"]))
+    out_split = saved_split
+    if isinstance(template, DNDarray):
+        comm, device = template.comm, template.device
+        out_split = template.split  # the template names the layout wanted NOW
+        if tuple(template.shape) != gshape:
+            raise ValueError(
+                f"checkpoint leaf {entry['path']!r} has global shape {gshape}, "
+                f"target template has {tuple(template.shape)}"
+            )
+    else:
+        comm, device = sanitize_comm(None), devices_module.sanitize_device(None)
+    if saved_split is None or not gshape:
+        arr = _read_array_file(directory, entry["files"][0])
+        return factories.array(arr, dtype=dtype, split=out_split, device=device, comm=comm)
+    saved_split = int(saved_split) % len(gshape)
+    # open every shard's lazy handle ONCE — read_block runs per target
+    # device, and reopening mmaps O(devices x shards) times would multiply
+    # open+header-parse round trips on the network filesystems this targets
+    shards = [
+        (frag["start"], frag["stop"], _open_array_lazy(directory, frag))
+        for frag in sorted(
+            (f for f in entry["files"] if f.get("rank") is not None),
+            key=lambda f: f["start"],
+        )
+    ]
+
+    def read_block(sl):
+        # general global-slice read: intersect the requested range along the
+        # SAVED split with each shard (other dims pass through), so the
+        # target layout may slice along ANY axis, not just the saved one.
+        # _sharded_ingest hands open slice(None)s for untouched dims —
+        # normalize to concrete bounds first.
+        sl = tuple(
+            slice(s.start or 0, gshape[d] if s.stop is None else s.stop)
+            for d, s in enumerate(sl)
+        )
+        lo, hi = sl[saved_split].start, sl[saved_split].stop
+        pieces = []
+        for start, stop, mm in shards:
+            s, e = max(lo, start), min(hi, stop)
+            if s < e:
+                idx = list(sl)
+                idx[saved_split] = slice(s - start, e - start)
+                pieces.append(np.asarray(mm[tuple(idx)]))
+        if not pieces:
+            shape = [sl[d].stop - sl[d].start for d in range(len(gshape))]
+            shape[saved_split] = 0
+            return np.empty(tuple(shape), dtype=_np_dtype(entry["dtype"]))
+        return pieces[0] if len(pieces) == 1 else np.concatenate(pieces, axis=saved_split)
+
+    if out_split is None:
+        # same retry contract as the _sharded_ingest page-ins below: the
+        # mmap reads inside read_block hit the (possibly flaky) filesystem
+        full = resilience.call_with_retries(
+            "checkpoint.restore", read_block, tuple(slice(0, s) for s in gshape)
+        )
+        return factories.array(full, dtype=dtype, split=None, device=device, comm=comm)
+    return io_module._sharded_ingest(
+        read_block, gshape, dtype, int(out_split) % len(gshape), device, comm
+    )
+
+
+def _restore_manifest(directory: str, step: int, target: Any) -> Any:
+    from ..core.dndarray import DNDarray
+
+    doc = _read_manifest(directory, step)
+    paths, leaves, treedef = _flatten_with_paths(target)
+    by_path = {e["path"]: e for e in doc.get("leaves", ())}
+    if sorted(by_path) != sorted(paths):
+        missing = sorted(set(paths) - set(by_path))
+        extra = sorted(set(by_path) - set(paths))
+        raise ValueError(
+            f"checkpoint step {step} does not match the target structure: "
+            f"missing from checkpoint {missing[:5]}, not in target {extra[:5]}"
+        )
+    out = []
+    for pkey, tleaf in zip(paths, leaves):
+        entry = by_path[pkey]
+        kind = entry["kind"]
+        if kind == "py":
+            out.append(_decode_py(entry["value"]))
+        elif kind == "array":
+            arr = _read_array_file(directory, entry["files"][0])
+            tshape = getattr(tleaf, "shape", None)
+            if tshape is not None and tuple(tshape) != tuple(arr.shape):
+                raise ValueError(
+                    f"checkpoint leaf {pkey!r} has shape {tuple(arr.shape)}, "
+                    f"target template has {tuple(tshape)}"
+                )
+            out.append(arr)
+        elif kind == "dndarray":
+            out.append(_restore_dndarray(directory, entry, tleaf))
+        else:
+            raise CheckpointCorruptError(
+                f"checkpoint step {step} in {directory!r}: unknown leaf kind {kind!r}"
+            )
+    telemetry.record_checkpoint("restore", step)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _restore_legacy_file(path: str, label: str, target: Any) -> Any:
+    """Read + msgpack-decode + reconstruct one legacy blob at ``path`` (the
+    load-path probe memo skips the read+decode when verify just did it);
+    every failure surfaces as :class:`CheckpointCorruptError` naming the
+    file, never a cryptic flax deserialization error."""
+    from flax import serialization
+
+    global _LEGACY_PROBE
+    try:
+        state = None
+        probe, _LEGACY_PROBE = _LEGACY_PROBE, None
+        if probe is not None and probe[0] == path and _legacy_stat(path) == probe[1:3]:
+            state = probe[3]  # verify just decoded this exact file
+        if state is None:
+
+            def _read():
+                with open(path, "rb") as fh:
+                    return fh.read()
+
+            state = serialization.msgpack_restore(
+                resilience.call_with_retries("checkpoint.restore", _read)
+            )
+        return serialization.from_state_dict(target, state)
+    except Exception as exc:  # noqa: BLE001 - flax raises format-dependent types
+        raise CheckpointCorruptError(
+            f"legacy checkpoint {path!r} ({label}) failed to deserialize "
+            f"({exc!r}) — truncated/corrupt msgpack, or a target-structure "
+            "mismatch; no fallback taken"
+        ) from exc
+
+
+def _restore_legacy(directory: str, step: int, target: Any) -> Any:
+    restored = _restore_legacy_file(_legacy_path(directory, step), f"step {step}", target)
+    telemetry.record_checkpoint("restore", step)
+    return restored
+
+
+def _restore_step(directory: str, step: int, target: Any) -> Any:
+    if os.path.exists(_manifest_path(directory, step)):
+        return _restore_manifest(directory, step, target)
+    return _restore_legacy(directory, step, target)
+
+
+def load_checkpoint(
+    directory: str, target: Any, step: Optional[int] = None, strict: bool = False
+) -> Any:
     """Restore a checkpoint into the structure of ``target``.
 
     ``target`` is a template pytree (e.g. a freshly-initialized state dict);
-    its leaves' shapes/dtypes validate the restore. ``step=None`` loads the
-    newest. Accepts a direct file path in ``directory`` too.
+    its leaves' shapes validate the restore, DNDarray template leaves select
+    elastic restore onto their comm/device. ``step=None`` loads the newest
+    checkpoint **that verifies** — unverifiable newer checkpoints emit a
+    :class:`CheckpointCorruptWarning` and are skipped (``strict=True`` raises
+    :class:`CheckpointCorruptError` instead of falling back). An explicit
+    ``step=`` that does not exist raises ``FileNotFoundError`` listing the
+    available steps; an explicit step that exists but fails verification
+    raises :class:`CheckpointCorruptError` (no fallback — you asked for that
+    one). A direct manifest/msgpack file path is accepted as ``directory``.
     """
     if os.path.isfile(directory):
-        path = directory
-    else:
-        if step is None:
-            step = latest_step(directory)
-            if step is None:
-                raise FileNotFoundError(f"no checkpoints in {directory!r}")
-        path = os.path.join(directory, f"ckpt_{int(step)}.msgpack")
-    with open(path, "rb") as f:
-        return serialization.from_bytes(target, f.read())
+        name = os.path.basename(directory)
+        parent = os.path.dirname(directory) or "."
+        is_manifest = _MANIFEST_RE.match(name) is not None
+        m = _MANIFEST_RE.match(name) or _LEGACY_RE.match(name)
+        if m is None:
+            # the original API accepted ANY file path as a msgpack blob
+            # (renamed/copied checkpoints, `cp ckpt_100.msgpack best.msgpack`);
+            # keep that contract — decode failures surface as the same
+            # CheckpointCorruptError, and the decode IS the verification
+            restored = _restore_legacy_file(directory, "explicit file path", target)
+            telemetry.record_checkpoint("restore")
+            return restored
+        file_step = int(m.group(1))
+        # verify and restore the artifact the caller NAMED — an explicit
+        # legacy path must not resolve to a manifest sibling of the same step
+        if is_manifest:
+            problems = _verify_manifest_artifact(parent, file_step)
+        else:
+            problems = _verify_legacy_artifact(parent, file_step, keep_probe=True)
+        if problems:
+            telemetry.record_checkpoint("corrupt", file_step)
+            raise CheckpointCorruptError(
+                f"checkpoint {directory!r} (step {file_step}) failed verification: "
+                f"{'; '.join(problems[:3])} — no fallback (explicit file path given)"
+            )
+        restore = _restore_manifest if is_manifest else _restore_legacy
+        return restore(parent, file_step, target)
+
+    steps = _all_steps(directory)
+    if not steps:
+        raise FileNotFoundError(f"no checkpoints in {directory!r}")
+    if step is not None:
+        step = int(step)
+        if step not in steps:
+            raise FileNotFoundError(
+                f"no checkpoint for step {step} in {directory!r}; "
+                f"available steps: {steps}"
+            )
+        problems = _verify_step(directory, step, keep_probe=True)
+        if problems:
+            telemetry.record_checkpoint("corrupt", step)
+            raise CheckpointCorruptError(
+                f"checkpoint step {step} in {directory!r} failed verification: "
+                f"{'; '.join(problems[:3])} — no fallback (explicit step= requested)"
+            )
+        return _restore_step(directory, step, target)
+
+    skipped: List[Tuple[int, List[str]]] = []
+    for s in reversed(steps):
+        problems = _verify_step(directory, s, keep_probe=True)
+        if not problems:
+            if skipped:
+                telemetry.record_checkpoint("fallback", s)
+                warnings.warn(
+                    CheckpointCorruptWarning(
+                        f"checkpoint step(s) {[t for t, _ in skipped]} in {directory!r} "
+                        f"failed verification ({skipped[0][1][0]}); falling back to the "
+                        f"newest checkpoint that verifies: step {s}"
+                    ),
+                    stacklevel=2,
+                )
+            return _restore_step(directory, s, target)
+        telemetry.record_checkpoint("corrupt", s)
+        if strict:
+            raise CheckpointCorruptError(
+                f"checkpoint step {s} in {directory!r} failed verification: "
+                f"{'; '.join(problems[:3])} — strict=True forbids falling back "
+                f"to an older checkpoint (available steps: {steps})"
+            )
+        skipped.append((s, problems))
+    raise CheckpointCorruptError(
+        f"no checkpoint in {directory!r} verifies — tried steps "
+        f"{[t for t, _ in skipped]}; newest failure: {skipped[0][1][:3]}"
+    )
+
+
+# ----------------------------------------------------------------------
+# retention + debris GC
+# ----------------------------------------------------------------------
+def gc_checkpoints(directory: str, keep: int = 3, protect_step: Optional[int] = None) -> None:
+    """Validity-aware keep-N retention plus a debris sweep.
+
+    Deletes committed checkpoints beyond the newest ``keep`` (``keep <= 0``
+    skips retention), but NEVER the last checkpoint that verifies: when none
+    of the kept steps verifies, the newest verifiable older checkpoint is
+    protected instead of culled. Sweeps orphaned temp/staging debris —
+    legacy ``ckpt_*.msgpack.tmp``, ``*.tmp-*`` staging files, payload
+    directories no committed manifest references — that is older than the
+    newest committed manifest (an in-flight save's staging is never younger
+    than the newest commit by less than a rename). Only the I/O-owning
+    process deletes; any failure degrades to a warning and leaves the rest
+    for the next sweep (``checkpoint.gc`` fault site).
+    """
+    from ..core import multihost
+
+    if not multihost.io_owner():
+        return  # pragma: no cover - multi-host only
+    try:
+        swept = _gc_inner(directory, keep, protect_step)
+        if swept:
+            telemetry.record_checkpoint("gc", protect_step, detail=f"removed {swept}")
+    except Exception as exc:  # noqa: BLE001 - GC must never fail the save
+        warnings.warn(
+            f"checkpoint GC in {directory!r} failed ({exc!r}); "
+            "debris left for the next sweep",
+            stacklevel=2,
+        )
+
+
+def _gc_remove(path: str, tree: bool = False) -> bool:
+    try:
+        if resilience._ARMED:
+            resilience.check("checkpoint.gc")
+        if tree:
+            shutil.rmtree(path)
+        else:
+            os.remove(path)
+        return True
+    except OSError:
+        return False  # transient/injected: the next sweep gets it
+
+
+def _gc_inner(directory: str, keep: int, protect_step: Optional[int]) -> int:
+    if resilience._ARMED:
+        # one check at sweep entry (plus one per deletion below): an armed
+        # gc fault exercises the degrade path even when nothing is deletable
+        resilience.check("checkpoint.gc")
+    committed = _committed(directory)
+    steps = sorted(committed)
+    swept = 0
+
+    # --- keep-N retention, validity-aware -----------------------------
+    protect_valid: Optional[int] = None
+    if keep > 0 and len(steps) > keep:
+        kept, doomed = steps[-keep:], steps[:-keep]
+        # the step just committed by the enclosing save verifies by
+        # construction — skip re-hashing the whole kept window for it
+        kept_has_valid = protect_step in kept or any(
+            not verify_checkpoint(directory, s) for s in reversed(kept)
+        )
+        if not kept_has_valid:
+            # the whole kept window is unverifiable: protect the newest
+            # older checkpoint that verifies — never delete the last good one
+            for s in reversed(doomed):
+                if not verify_checkpoint(directory, s):
+                    protect_valid = s
+                    break
+        for s in doomed:
+            if s == protect_step or s == protect_valid:
+                continue
+            swept += _delete_step(directory, s)
+
+    # --- debris sweep -------------------------------------------------
+    manifest_mtimes = []
+    referenced = set()
+    unreadable_steps = set()
+    for s, name in _committed(directory).items():
+        if _MANIFEST_RE.match(name):
+            full = os.path.join(directory, name)
+            try:
+                manifest_mtimes.append(os.path.getmtime(full))
+                referenced.add(_read_manifest(directory, s).get("payload"))
+            except Exception:  # noqa: BLE001
+                # a manifest unreadable RIGHT NOW (transient mount blip — or
+                # genuinely torn, indistinguishable from here) may still
+                # reference its step's payload: protect every payload dir of
+                # that step rather than rmtree a committed checkpoint's data
+                # on a flaky read; retention removes torn steps explicitly
+                unreadable_steps.add(s)
+    if not manifest_mtimes:
+        return swept
+    newest = max(manifest_mtimes)
+
+    def _older(path: str) -> bool:
+        try:
+            return os.path.getmtime(path) < newest
+        except OSError:
+            return False
+
+    for name in sorted(os.listdir(directory)):
+        full = os.path.join(directory, name)
+        if os.path.isdir(full):
+            m = _PAYLOAD_RE.match(name)
+            if (
+                m
+                and name not in referenced
+                and int(m.group(1)) not in unreadable_steps
+                and _older(full)
+            ):
+                swept += _gc_remove(full, tree=True)  # uncommitted staging / orphan
+            elif name in referenced:
+                # stale staging temps inside a LIVE payload dir (a crashed
+                # attempt that reused the directory): sweep just the temps
+                for sub in os.listdir(full):
+                    subfull = os.path.join(full, sub)
+                    if ".tmp-" in sub and _older(subfull):
+                        swept += _gc_remove(subfull)
+        elif (_LEGACY_TMP_RE.match(name) or ".tmp-" in name) and _older(full):
+            swept += _gc_remove(full)  # crash-orphaned temp files
+    return swept
+
+
+def _delete_step(directory: str, step: int) -> int:
+    """Delete one committed checkpoint crash-consistently: any legacy blob
+    first (the manifest, which wins step resolution, still commits the step),
+    then the manifest — the commit point: the checkpoint becomes invisible —
+    and only once THAT unlink succeeded, its payload directory. A failure or
+    crash at any point leaves a still-committed checkpoint intact or
+    unreferenced debris for the next sweep — never a committed manifest
+    whose payload is gone, and never a step resurrecting as stale legacy
+    data."""
+    removed = 0
+    lpath = _legacy_path(directory, step)
+    if os.path.exists(lpath):
+        if not _gc_remove(lpath):
+            return removed  # retry next sweep; the step stays fully intact
+        removed += 1
+    mpath = _manifest_path(directory, step)
+    if os.path.exists(mpath):
+        try:
+            payload = _read_manifest(directory, step).get("payload")
+        except Exception:  # noqa: BLE001 - torn manifest: still delete it
+            payload = None
+        if not _gc_remove(mpath):
+            return removed  # still committed: its payload must not be touched
+        removed += 1
+        if payload:
+            full = os.path.join(directory, payload)
+            if os.path.isdir(full):
+                removed += _gc_remove(full, tree=True)
+    return removed

@@ -32,12 +32,16 @@ HEAT_TPU_TELEMETRY=1 \
   python -m pytest tests/test_telemetry.py tests/test_eager_chain.py tests/test_linalg_depth.py -q -x
 # resilience leg: the suite runs under the deterministic ambient fault mix
 # (core/resilience.py 'ci' preset: fused compiles/executes fail periodically
-# and degrade to eager, transient io errors are retried) — recovery is
-# proven by the suite simply staying green while faults fire. Explicit
-# inject() scopes suspend the ambient specs, so exact-count pins stay exact.
+# and degrade to eager, transient io errors are retried, checkpoint
+# write/commit/restore attempts absorb transient faults and gc deletions
+# degrade to debris-for-the-next-sweep) — recovery is proven by the suite
+# simply staying green while faults fire. Explicit inject() scopes suspend
+# the ambient specs, so exact-count pins stay exact; the checkpoint suite's
+# kill-mid-save resume loop runs here too (ISSUE 4 acceptance).
 echo "=== faults injected (HEAT_TPU_FAULTS=ci) ==="
 HEAT_TPU_FAULTS=ci HEAT_TPU_TELEMETRY=1 \
-  python -m pytest tests/test_resilience.py tests/test_resilience_io.py tests/test_io_errors.py -q -x
+  python -m pytest tests/test_resilience.py tests/test_resilience_io.py tests/test_io_errors.py \
+    tests/test_checkpoint_resilience.py tests/test_checkpoint_profiling.py -q -x
 # the coverage gate (reference codecov.yml target semantics): the merged
 # matrix coverage must clear the floor or the matrix run fails. On runtimes
 # without sys.monitoring (Python < 3.12) no cov_mesh*.json legs are produced

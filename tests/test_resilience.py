@@ -116,12 +116,18 @@ class TestHarness(TestCase):
         self.assertGreaterEqual(len(specs), 4)
         for spec in specs:
             # only seams with a recovery behavior behind them may be in the
-            # background mix — the suite must stay green under it
+            # background mix — the suite must stay green under it: fused
+            # programs degrade to eager, io/checkpoint attempts retry
+            # transient faults, checkpoint GC degrades to debris-for-later
             self.assertTrue(
-                spec.pattern.startswith(("fusion.", "io.")),
+                spec.pattern.startswith(("fusion.", "io.", "checkpoint.")),
                 f"{spec.pattern} has no recovery path",
             )
             self.assertIsNotNone(spec.every)
+            if spec.pattern.startswith(("io.", "checkpoint.")):
+                # retried seams must inject the retryable (transient OSError)
+                # failure mode, not an unconditional crash
+                self.assertTrue(issubclass(spec.exc, OSError), spec.pattern)
 
     def test_malformed_env_entry_warns_and_skips(self):
         with warnings.catch_warnings(record=True) as caught:

@@ -280,12 +280,21 @@ def __reduce_op(
     # fusion recorder: reductions defer too, so a chain ending in (or mixing)
     # k reductions costs one program + one device sync at the forcing point
     # instead of k dispatches (``initial`` is accepted-and-ignored exactly as
-    # in the eager path below)
+    # in the eager path below). A deferred reduction ACROSS the split axis is
+    # a collective NODE: its psum is GSPMD-inserted inside the fused program
+    # (no dispatch-time verb call), so it is counted in the fused-collective
+    # ledger and cross-checked against the program HLO, not collective_counts
     if out is None and fusion.active():
         lazy = fusion.defer_reduce(partial_op, x, axis, keepdims, out_split, dtype, kwargs)
         if lazy is not None:
             if telemetry._MODE:
                 telemetry.record_dispatch("reduce", fused=True)
+                if (
+                    split is not None
+                    and (axes is None or split in axes)
+                    and x.comm.is_distributed()
+                ):
+                    telemetry.record_fused_collective("reduce.psum")
             return lazy
         # defer_reduce left its own (detailed) unfused breadcrumb
     elif telemetry._MODE:

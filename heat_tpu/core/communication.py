@@ -55,6 +55,11 @@ __all__ = [
 
 SPLIT_AXIS = "split"
 
+# cap on the per-instance pure-metadata memos (counts/displs, lshape maps):
+# workloads with data-dependent shapes must not grow them for the process
+# lifetime — past the cap the memo resets (recompute is cheap arithmetic)
+_METADATA_CACHE_SIZE = 1024
+
 
 def _type_min(dtype):
     """Most-negative representable value (neutral element of max)."""
@@ -266,9 +271,16 @@ class MeshCommunication(Communication):
         if devices is None:
             devices = jax.devices()
         self._devices = tuple(devices)
+        self.device_set = frozenset(self._devices)  # fusion batch-mesh gate
         self.axis_name = axis_name
         self.mesh = Mesh(np.asarray(self._devices), (axis_name,))
         self.__sharding_cache = {}
+        # pure-metadata memos: counts/displs and lshape maps are recomputed
+        # on EVERY distributed op (chunk(), counts_displs(), the io/ckpt
+        # shard protocols) from nothing but (shape, split, size) — cache per
+        # instance since the layout is deterministic
+        self.__counts_cache = {}
+        self.__lshape_cache = {}
         try:
             self.rank = jax.process_index()
         except Exception:  # pragma: no cover
@@ -319,12 +331,20 @@ class MeshCommunication(Communication):
         self, shape: Sequence[int], split: int
     ) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
         """Per-device counts and displacements along ``split`` under GSPMD's
-        ceil-division block rule."""
-        n = shape[split]
+        ceil-division block rule. Memoized per (split size, axis): this is
+        the hot pure-metadata path every distributed op, ``counts_displs()``
+        call and shard-protocol walk recomputes."""
+        n = int(shape[split])
+        cached = self.__counts_cache.get((n, split))
+        if cached is not None:
+            return cached
         k = self.size
         block = -(-n // k) if n else 0
         counts = tuple(max(0, min(block, n - i * block)) for i in range(k))
         displs = tuple(min(i * block, n) for i in range(k))
+        if len(self.__counts_cache) >= _METADATA_CACHE_SIZE:
+            self.__counts_cache.clear()  # churning shapes: recompute > grow
+        self.__counts_cache[(n, split)] = (counts, displs)
         return counts, displs
 
     def chunk(
@@ -350,12 +370,20 @@ class MeshCommunication(Communication):
     def lshape_map(self, shape: Sequence[int], split: Optional[int]) -> np.ndarray:
         """(size, ndim) array of each device's local shape (reference
         dndarray.py:569-600 computes this with an Allreduce; here it is pure
-        arithmetic because the layout is deterministic)."""
-        out = np.empty((self.size, len(shape)), dtype=np.int64)
-        for r in range(self.size):
-            _, lshape, _ = self.chunk(shape, split, rank=r)
-            out[r] = lshape
-        return out
+        arithmetic because the layout is deterministic). Memoized per
+        (shape, split); callers receive a fresh copy, so mutating a returned
+        map can never poison the cache."""
+        key = (tuple(int(s) for s in shape), split)
+        cached = self.__lshape_cache.get(key)
+        if cached is None:
+            cached = np.empty((self.size, len(shape)), dtype=np.int64)
+            for r in range(self.size):
+                _, lshape, _ = self.chunk(shape, split, rank=r)
+                cached[r] = lshape
+            if len(self.__lshape_cache) >= _METADATA_CACHE_SIZE:
+                self.__lshape_cache.clear()  # churning shapes: recompute > grow
+            self.__lshape_cache[key] = cached
+        return cached.copy()
 
     # ------------------------------------------------------------------
     # collective helpers (reference communication.py:88-1891)

@@ -248,6 +248,17 @@ class DNDarray:
                 return self.parray
         return self.parray
 
+    def _note_blocking_sync(self, kind: str) -> None:
+        """Telemetry seam for host boundaries (``item``/``numpy``/shard
+        reads): counted as a *blocking sync* only when a pending recorded
+        chain must be materialized synchronously here — reading a value whose
+        program is already dispatched (async forcing) is free and does not
+        count. One isinstance on the disabled path."""
+        if telemetry._MODE:
+            arr = self.__array
+            if isinstance(arr, fusion.LazyArray) and arr._value is None:
+                telemetry.record_blocking_sync(kind)
+
     @property
     def larray(self) -> jax.Array:
         """The **logical** global ``jax.Array`` (see module docstring): the
@@ -307,6 +318,7 @@ class DNDarray:
         """Per-device **logical** local shards (host copies), in device order:
         each physical shard with its padding rows sliced off (tail devices of
         a ragged split may hold empty logical shards)."""
+        self._note_blocking_sync("shards")
         phys = self.parray
         if not self.padded:
             return [np.asarray(s.data) for s in phys.addressable_shards]
@@ -334,6 +346,7 @@ class DNDarray:
         the sharded checkpoint writer (``utils/checkpoint.py``): one host
         transfer per block, never a global gather. Forces a pending recorded
         chain (see :attr:`parray`)."""
+        self._note_blocking_sync("shards")
         split = self.__split
         if split is None or self.ndim == 0:
             yield 0, np.asarray(self.larray)  # local payload, not a gather
@@ -438,7 +451,16 @@ class DNDarray:
     def resplit_(self, axis: Optional[int] = None) -> "DNDarray":
         """In-place redistribution to a new split axis (reference
         dndarray.py:1235-1357: Allgatherv / tile-P2P; here one ``device_put``
-        whose resharding collectives XLA chooses)."""
+        whose resharding collectives XLA chooses).
+
+        Under collective-aware fusion a PENDING recorded chain stays
+        recorded: the redistribution becomes a collective node in the DAG
+        (``fusion.defer_reshard`` — a sharding constraint the fused
+        program's partitioner schedules), so chains spanning a resplit
+        compile into one program instead of fencing here. The
+        ``collective.reshard`` fault site still fires at record-or-dispatch
+        time, before any metadata mutates; ``HEAT_TPU_FUSION_COLLECTIVES=0``
+        restores the force-at-collective behavior."""
         axis = sanitize_axis(self.__gshape, axis)
         if axis == self.__split:
             return self
@@ -448,6 +470,22 @@ class DNDarray:
             # the site lets tests prove it surfaces BEFORE the wrapper's
             # metadata is mutated (no half-resharded state)
             resilience.check("collective.reshard")
+        payload = self.__array
+        if (
+            isinstance(payload, fusion.LazyArray)
+            and payload._value is None
+            and fusion.collectives_active()
+        ):
+            node = fusion.defer_reshard(
+                payload, self.__gshape, self.__split, was_padded, axis, self.__comm
+            )
+            if node is not None:
+                self.__split = axis
+                self.__array = node
+                fusion.register_root(self)
+                return self
+            # recording declined (defer_reshard left the breadcrumb): force
+            # and reshard eagerly below — today's behavior
         self._force_payload(_T_COLLECTIVE)  # redistribution = collective
         logical = self.larray
         self.__split = axis
@@ -576,16 +614,22 @@ class DNDarray:
         else:
             casted = arr.astype(dtype.jax_type())
         if copy:
-            return DNDarray(
+            out = DNDarray(
                 casted, self.__gshape, dtype, self.__split, self.__device, self.__comm
             )
+            if isinstance(casted, fusion.LazyArray):
+                fusion.register_root(out)  # async-forcing batch candidate
+            return out
         self.__array = casted
         self.__dtype = dtype
+        if isinstance(casted, fusion.LazyArray):
+            fusion.register_root(self)
         return self
 
     def numpy(self) -> np.ndarray:
         """Gather the global (logical) array to host numpy (reference
         dndarray.py:991-1003); padding never leaves the device."""
+        self._note_blocking_sync("numpy")
         return np.asarray(jax.device_get(self.larray))
 
     def __array__(self, dtype=None) -> np.ndarray:
@@ -596,6 +640,7 @@ class DNDarray:
         """The single scalar value (reference dndarray.py:965)."""
         if self.size != 1:
             raise ValueError("only one-element DNDarrays can be converted to Python scalars")
+        self._note_blocking_sync("item")
         return self.larray.item()
 
     def tolist(self, keepsplit: bool = False) -> list:

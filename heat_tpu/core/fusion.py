@@ -45,12 +45,52 @@ per-op eager dispatch (bitwise the eager engines' result), records a
 loops skip the doomed compile from then on. The
 ``fusion.record``/``fusion.compile``/``fusion.execute`` injection sites
 exist so tests can trigger exactly these failures deterministically.
+
+Collective-aware fusion + asynchronous forcing
+----------------------------------------------
+The DAG also records **collective nodes**, so chains spanning communication
+compile into the SAME cached program instead of fencing at each collective
+(the GSPMD lesson: let XLA schedule the psums inside one partitioned
+program):
+
+* split-axis reductions already record through ``defer_reduce`` — the psum
+  is GSPMD-inserted when the fused program compiles;
+* ``defer_reshard`` records a redistribution (``resplit_`` / out-of-place
+  ``resplit`` of a pending chain) as a ``with_sharding_constraint`` node
+  (plus explicit un-pad/re-pad nodes for ragged splits);
+* ``defer_apply`` records a ``MeshCommunication.apply``-style shard_map
+  kernel (single-output) as a DAG node, so record→kernel→record chains stay
+  one program.
+
+Forcing is **asynchronous**: ``force()`` dispatches the fused program and
+installs the resulting ``jax.Array`` futures without blocking or reading
+device data — only genuine host boundaries (``float()``/``item()``,
+``numpy()``, printing, I/O shard reads) synchronize. Independent DAG roots
+alive at a forcing point (tracked in a weak registry of pending wrappers)
+are batched into ONE multi-output jitted program when their results are
+small (``HEAT_TPU_FUSION_BATCH_BYTES``), so e.g. ``mean``/``var``/``std``
+of one operand cost one dispatch and at most one blocking sync.
+
+Fault-site contract: ``collective.reshard``/``collective.apply`` fire at
+*record* time (every deferral, before any metadata mutates); the in-kernel
+``collective.<verb>`` sites fire whenever the kernel is actually traced
+(first record of a signature, and the fused program's compile). Telemetry's
+``collective_counts()`` therefore only sees collectives at trace time once
+they ride fused programs — ``record_fused_collective`` counts the recorded
+collective nodes and :func:`program_hlo` + ``telemetry.hlo_collective_counts``
+cross-check the compiled program.
+
+``HEAT_TPU_FUSION_COLLECTIVES=0`` is the escape hatch restoring
+force-at-collective behavior (no collective nodes, no multi-root batching);
+``HEAT_TPU_FUSION=0`` still disables recording entirely.
 """
 
 from __future__ import annotations
 
 import functools
+import itertools
 import os
+import weakref
 from collections import OrderedDict
 from contextlib import contextmanager
 from typing import Optional, Tuple
@@ -64,12 +104,19 @@ from . import resilience, telemetry
 __all__ = [
     "LazyArray",
     "active",
+    "collectives_active",
+    "collectives_disabled",
     "disabled",
+    "defer_apply",
+    "defer_reshard",
     "force",
     "is_deferred",
     "cache_stats",
     "clear_cache",
     "clear_quarantine",
+    "program_hlo",
+    "register_root",
+    "wrap_node",
 ]
 
 _OFF_VALUES = ("0", "false", "off", "no")
@@ -89,6 +136,20 @@ _QUARANTINE_SIZE = int(os.environ.get("HEAT_TPU_FUSION_QUARANTINE", "256"))
 # measurable on the record hot path); in-process toggling goes through
 # set_enabled()/disabled(), cross-process through the env var
 _ENABLED = os.environ.get("HEAT_TPU_FUSION", "1").lower() not in _OFF_VALUES
+
+# collective-aware fusion escape hatch: with it off, collectives force the
+# chain exactly as before this layer existed (resplit_/apply dispatch
+# eagerly) and forcing points never batch independent roots
+_COLLECTIVES = (
+    os.environ.get("HEAT_TPU_FUSION_COLLECTIVES", "1").lower() not in _OFF_VALUES
+)
+
+# async multi-root forcing: at a forcing point, other live pending roots are
+# dispatched in the SAME multi-output program when their results are small
+# (batching a big intermediate would materialize an extra HBM write the
+# chain's consumer never asked for); count-capped for program-size sanity
+_BATCH_MAX = int(os.environ.get("HEAT_TPU_FUSION_BATCH", "16"))
+_BATCH_BYTES = int(os.environ.get("HEAT_TPU_FUSION_BATCH_BYTES", "16384"))
 
 
 def active() -> bool:
@@ -113,6 +174,30 @@ def disabled():
         yield
     finally:
         set_enabled(prev)
+
+
+def collectives_active() -> bool:
+    """Whether collective nodes record into the DAG and forcing batches
+    independent roots (``HEAT_TPU_FUSION_COLLECTIVES`` escape hatch)."""
+    return _ENABLED and _COLLECTIVES
+
+
+def set_collectives_enabled(flag: bool) -> bool:
+    """Flip collective-aware fusion in-process; returns the previous state."""
+    global _COLLECTIVES
+    prev, _COLLECTIVES = _COLLECTIVES, bool(flag)
+    return prev
+
+
+@contextmanager
+def collectives_disabled():
+    """Context manager restoring force-at-collective behavior (the parity
+    tests and the ``HEAT_TPU_FUSION_COLLECTIVES=0`` matrix leg)."""
+    prev = set_collectives_enabled(False)
+    try:
+        yield
+    finally:
+        set_collectives_enabled(prev)
 
 
 class LazyArray:
@@ -160,6 +245,24 @@ def _unpad_op(x, *, axis, size):
     # the mask step of pad+mask: slice the suffix padding off the split dim
     # INSIDE the fused program, so cross-split reductions never see padding
     return jax.lax.slice_in_dim(x, 0, size, axis=axis)
+
+
+def _pad_split_op(x, *, axis, pad):
+    # the pad step of pad+mask, as a DAG node: zero-pad the new split dim to
+    # p*ceil(n/p) inside the fused program (deferred reshard of ragged dims)
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _reshard_op(x, *, sharding):
+    # a recorded redistribution: under a trace (the fused program, or the
+    # record-time eval_shape) it is a sharding constraint GSPMD satisfies
+    # with its own collective schedule; in the eager replay (guarded
+    # forcing's degraded arm) it is the same device_put resplit_ used to do
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(x, sharding)
+    return jax.device_put(x, sharding)
 
 
 def _aval(c) -> Tuple[Tuple[int, ...], np.dtype]:
@@ -254,12 +357,10 @@ def _leaf_sig(v):
     return ("Ls", type(v))
 
 
-def _signature(root: LazyArray):
-    """Postorder structural signature + the leaf operands, DAG-deduplicated
-    (a shared subexpression appears once and is referenced by index)."""
-    entries = []
-    leaves = []
-    memo = {}
+def _walk(root, entries, leaves, memo) -> None:
+    """Postorder walk of one DAG root into the shared (entries, leaves,
+    memo) accumulators — a subexpression shared with an earlier walk appears
+    once and is referenced by index."""
     stack = [(root, False)]
     while stack:
         obj, expanded = stack.pop()
@@ -279,13 +380,26 @@ def _signature(root: LazyArray):
         else:
             memo[oid] = len(entries)
             entries.append((obj.fn, tuple(memo[id(c)] for c in obj.children), obj.kw))
+
+
+def _signature(roots):
+    """Structural signature + the leaf operands of one or more DAG roots.
+    The final ``("R", ...)`` entry records each root's position —
+    multi-output forcing's return order."""
+    entries = []
+    leaves = []
+    memo = {}
+    for root in roots:
+        _walk(root, entries, leaves, memo)
+    entries.append(("R", tuple(memo[id(r)] for r in roots)))
     return tuple(entries), leaves
 
 
 def _build(sig):
     """The executable for a structural signature: replays the DAG from the
-    leaf operands. One instance per signature, jitted once — steady-state
-    calls with fresh same-shaped inputs reuse the compiled program."""
+    leaf operands and returns the tuple of root values (one per ``("R",...)``
+    position). One instance per signature, jitted once — steady-state calls
+    with fresh same-shaped inputs reuse the compiled program."""
 
     def run(*leaves):
         vals = []
@@ -294,10 +408,12 @@ def _build(sig):
             if e[0] == "L" or e[0] == "Ls":
                 vals.append(leaves[li])
                 li += 1
+            elif e[0] == "R":
+                return tuple(vals[i] for i in e[1])
             else:
                 fn, idxs, kw = e
                 vals.append(fn(*(vals[i] for i in idxs), **dict(kw)))
-        return vals[-1]
+        raise AssertionError("signature missing its root entry")  # pragma: no cover
 
     return run
 
@@ -307,13 +423,87 @@ def _family(sig) -> tuple:
     detector's key: the same family missing under churning shapes is the
     recompile pathology worth warning about."""
     return tuple(
-        getattr(e[0], "__name__", str(e[0])) for e in sig if e[0] not in ("L", "Ls")
+        getattr(e[0], "__name__", str(e[0]))
+        for e in sig
+        if e[0] not in ("L", "Ls", "R")
     )
 
 
 def _leaf_key(sig) -> tuple:
     """The leaf (shape/dtype/sharding) part of a signature."""
     return tuple(e for e in sig if e[0] in ("L", "Ls"))
+
+
+# ----------------------------------------------------------------------
+# live-root registry: async multi-root forcing
+# ----------------------------------------------------------------------
+# weakrefs to DNDarray wrappers whose payload is (was) a pending LazyArray,
+# in registration order: a forcing point batches the still-pending ones into
+# one multi-output program. Entries die with their wrappers automatically;
+# already-forced survivors are pruned during gathering.
+_ROOT_SEQ = itertools.count()
+_LIVE_ROOTS: "weakref.WeakValueDictionary[int, object]" = weakref.WeakValueDictionary()
+
+
+def register_root(wrapper) -> None:
+    """Track a DNDarray whose payload is a pending recorded chain as an
+    async-forcing batch candidate (every deferral site calls this). No-op
+    with collective-aware fusion off — forcing then never batches."""
+    if _COLLECTIVES:
+        _LIVE_ROOTS[next(_ROOT_SEQ)] = wrapper
+
+
+def _node_nbytes(node: LazyArray) -> int:
+    size = 1
+    for s in node.shape:
+        size *= int(s)
+    return size * np.dtype(node.dtype).itemsize
+
+
+def _gather_batch(entries, leaves, memo, roots):
+    """Select other live pending roots to dispatch alongside the triggering
+    root, in stable registration order (nondeterministic ordering would
+    churn the program cache), walking each selection into the shared
+    signature accumulators as it is taken. ``memo`` is the signature walk so
+    far: candidates already INTERIOR to a selected DAG are skipped — they
+    would add an output write nothing asked for, and whether a caller
+    happens to hold an intermediate must not change the program's cache key
+    (a later read finds the whole-chain force already materialized the
+    ancestor, and forces the held node with its own small program). Only
+    small results batch (a big disjoint root keeps its own dispatch), and
+    only candidates living on the SAME device set as the triggering root's
+    leaves — one jitted program cannot span two meshes, and a mixed batch
+    would dispatch-fail and spuriously degrade a perfectly valid chain."""
+    device_set = None
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array):
+            sharding = getattr(leaf, "sharding", None)
+            if sharding is not None:
+                device_set = sharding.device_set
+                break
+    if device_set is None:
+        return  # no placed operand to anchor the mesh: skip batching
+    stale = []
+    for key in sorted(_LIVE_ROOTS.keys()):
+        if len(roots) >= _BATCH_MAX:
+            break
+        wrapper = _LIVE_ROOTS.get(key)
+        if wrapper is None:
+            continue
+        payload = wrapper._payload
+        if not (isinstance(payload, LazyArray) and payload._value is None):
+            stale.append(key)  # forced since registration: stop tracking
+            continue
+        if id(payload) in memo:
+            continue  # interior to (or already selected by) this batch
+        if _node_nbytes(payload) > _BATCH_BYTES:
+            continue
+        if getattr(wrapper.comm, "device_set", None) != device_set:
+            continue  # different comm/mesh: never fuse across device sets
+        _walk(payload, entries, leaves, memo)
+        roots.append(payload)
+    for key in stale:
+        _LIVE_ROOTS.pop(key, None)
 
 
 def _quarantine(sig) -> None:
@@ -353,10 +543,18 @@ def _degrade(sig, leaves, exc, missed):
 def force(node):
     """Materialize a recorded DAG as one cached, jitted XLA program.
 
+    ASYNCHRONOUS: the dispatch installs the resulting ``jax.Array`` futures
+    and returns immediately — nothing here blocks on or reads device data,
+    so only genuine host boundaries (``item()``, ``numpy()``, printing, I/O
+    shard reads) synchronize. Under collective-aware fusion
+    (:func:`collectives_active`), other live pending roots with small
+    results (see :func:`_gather_batch`) ride the SAME multi-output program,
+    so a later read of theirs finds the value already in flight.
+
     Under an active trace (an enclosing ``jax.jit``/``eval_shape``) the
-    program executes into that trace, so the result may be a tracer — it is
-    then returned WITHOUT being cached on the node (caching a tracer would
-    leak it past the trace's lifetime).
+    program executes into that trace, so the results may be tracers — they
+    are then returned WITHOUT being cached on the nodes (caching a tracer
+    would leak it past the trace's lifetime).
 
     GUARDED: a program that fails to trace/compile/execute (injectable at
     the ``fusion.compile``/``fusion.execute`` sites) degrades to per-op
@@ -368,14 +566,26 @@ def force(node):
         return node
     if node._value is not None:
         return node._value
-    sig, leaves = _signature(node)
+    roots = [node]
+    entries = []
+    leaves = []
+    memo = {}
+    _walk(node, entries, leaves, memo)
+    if _COLLECTIVES and _ENABLED and _LIVE_ROOTS and jax.core.trace_state_clean():
+        # never batch while executing into an enclosing jit/eval_shape
+        # trace: the extra roots would come back as tracers (uncacheable —
+        # see below), tracing their subgraphs and baking their operands
+        # into the user's compiled program as outputs nothing reads
+        _gather_batch(entries, leaves, memo, roots)
+    entries.append(("R", tuple(memo[id(r)] for r in roots)))
+    sig = tuple(entries)
     _STATS["forces"] += 1
     if _QUARANTINE and sig in _QUARANTINE:
         # known-bad DAG key: skip the failing compile, replay per-op
         _STATS["quarantine_hits"] += 1
         if telemetry._MODE:
             telemetry.record_force(telemetry.current_trigger(), node.depth, compiled=False)
-        value = _build(sig)(*leaves)
+        values = _build(sig)(*leaves)
     else:
         prog = _PROGRAMS.get(sig)
         missed = prog is None
@@ -398,23 +608,27 @@ def force(node):
                 # jax.jit builds lazily, so the XLA compile happens inside the
                 # first call — the injection sites model that split
                 resilience.check("fusion.compile" if missed else "fusion.execute")
-            value = prog(*leaves)
+            values = prog(*leaves)
         except Exception as exc:  # noqa: BLE001 - routed through ONE policy
             if not resilience.force_recoverable(exc):
                 raise
-            value = _degrade(sig, leaves, exc, missed)
-    # under an enclosing trace the jit bind joins that trace and the value is
-    # a tracer even though every leaf is concrete (verified on jax 0.4.37);
-    # caching is gated on the value's actual concreteness, not ambient state
-    # (the errstate non-finite policy is applied at the DNDarray.parray seam,
-    # which knows the logical extent — the padding suffix of a ragged split
-    # holds unspecified garbage and must not be checked)
-    if not isinstance(value, jax.core.Tracer):
-        node._value = value
-        # drop the recorded graph: later forces of ancestors treat this node
-        # as a leaf, and the chain's operand buffers become collectable
-        node.children = ()
-    return value
+            values = _degrade(sig, leaves, exc, missed)
+    if telemetry._MODE:
+        telemetry.record_async_dispatch(len(roots))
+    # under an enclosing trace the jit bind joins that trace and the values
+    # are tracers even though every leaf is concrete (verified on jax
+    # 0.4.37); caching is gated on each value's actual concreteness, not
+    # ambient state (the errstate non-finite policy is applied at the
+    # DNDarray.parray seam, which knows the logical extent — the padding
+    # suffix of a ragged split holds unspecified garbage, never checked)
+    for root, value in zip(roots, values):
+        if not isinstance(value, jax.core.Tracer):
+            root._value = value
+            # drop the recorded graph: later forces of ancestors treat this
+            # node as a leaf, and the chain's operand buffers become
+            # collectable
+            root.children = ()
+    return values[0]
 
 
 def is_deferred(x) -> bool:
@@ -440,10 +654,11 @@ def cache_stats() -> dict:
 
 
 def clear_cache() -> None:
-    """Drop every compiled program, lift every quarantine, and zero ALL
-    counters coherently."""
+    """Drop every compiled program, lift every quarantine, forget the live
+    async-forcing root registry, and zero ALL counters coherently."""
     _PROGRAMS.clear()
     _QUARANTINE.clear()
+    _LIVE_ROOTS.clear()
     _STATS.update(
         compiles=0, hits=0, forces=0, evictions=0, degraded=0, quarantine_hits=0
     )
@@ -532,7 +747,17 @@ def _wrap(node, gshape, split, ref):
     obj._DNDarray__comm = ref.comm
     obj._DNDarray__balanced = True
     obj._DNDarray__array = node
+    if _COLLECTIVES:
+        register_root(obj)
     return obj
+
+
+def wrap_node(node: LazyArray, gshape, split, ref):
+    """Public form of :func:`_wrap` for collective-node call sites outside
+    this module (deferred ``apply`` consumers wrap their own metadata)."""
+    if DNDarray is None:
+        _resolve_siblings()
+    return _wrap(node, tuple(int(s) for s in gshape), split, ref)
 
 
 def defer_binary(operation, t1, t2, jt, fn_kwargs):
@@ -689,3 +914,156 @@ def defer_cum(operation, x, axis, dtype):
     if node.shape != phys_shape:
         return _unfused("cum", "shape_changed")
     return _wrap(node, x.shape, x.split, x)
+
+
+# ----------------------------------------------------------------------
+# collective nodes: deferred reshard + deferred shard_map kernels
+# ----------------------------------------------------------------------
+def defer_reshard(payload: LazyArray, gshape, split, padded, axis, comm):
+    """Record a redistribution of a pending chain to split ``axis`` as DAG
+    nodes (un-pad the old split if ragged, re-pad the new one if ragged,
+    then a ``with_sharding_constraint`` the fused program's partitioner
+    satisfies with its own collective schedule). Returns the new payload
+    node, or None when recording fails recoverably (callers then force and
+    reshard eagerly — today's behavior).
+
+    The ``collective.reshard`` fault site is the CALLER's to check before
+    any metadata mutates (``resplit_`` does); this function only records.
+    """
+    if DNDarray is None:
+        _resolve_siblings()
+    try:
+        node = payload
+        if padded:
+            node = record(_unpad_op, (node,), axis=split, size=int(gshape[split]))
+        if axis is not None:
+            n = int(gshape[axis])
+            p = comm.size
+            block = -(-n // p) if n else 0
+            pad = block * p - n
+            if pad:
+                node = record(_pad_split_op, (node,), axis=axis, pad=pad)
+        target = comm.sharding(len(node.shape), axis)
+        node = record(_reshard_op, (node,), sharding=target)
+    except Exception as exc:  # narrowed: ONE policy decides what falls back
+        if not resilience.record_recoverable(exc):
+            raise
+        return _unfused("reshard", "record_failed:" + type(exc).__name__)
+    if telemetry._MODE:
+        telemetry.record_fused_collective("reshard")
+    return node
+
+
+@functools.lru_cache(maxsize=512)
+def _apply_fn(mesh, axis_name, kernel, in_splits, ndims, out_split, check_vma):
+    """Cached shard_map rendering of a ``MeshCommunication.apply`` call — one
+    stable function object per (mesh, kernel, layout) so the program cache
+    and the retrace ledger key deferred kernels exactly like any other op."""
+    from jax.sharding import PartitionSpec
+
+    def spec(ndim, split):
+        if split is None:
+            return PartitionSpec()
+        entries = [None] * ndim
+        entries[split] = axis_name
+        return PartitionSpec(*entries)
+
+    out_spec = (
+        PartitionSpec()
+        if out_split is None
+        else PartitionSpec(*([None] * out_split), axis_name)
+    )
+    fn = jax.shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=tuple(spec(nd, s) for nd, s in zip(ndims, in_splits)),
+        out_specs=out_spec,
+        check_vma=check_vma,
+    )
+
+    def run(*args):
+        return fn(*args)
+
+    run.__name__ = "apply:" + getattr(kernel, "__name__", "kernel")
+    return run
+
+
+def defer_apply(comm, kernel, xs, in_splits, out_split, check_vma: bool = False):
+    """Record a single-output ``shard_map`` kernel over ``comm``'s mesh as a
+    DAG node, so record→kernel→record chains compile into ONE program (the
+    deferred form of ``MeshCommunication.apply``). ``xs`` entries are
+    DNDarrays (pending chains stay pending) or concrete arrays; returns the
+    LazyArray node — callers wrap their own global metadata via
+    :func:`wrap_node` — or None to decline (multi-output kernels, padded or
+    tracer operands, record failures → the eager ``comm.apply`` path).
+
+    The ``collective.apply`` fault site fires here at record time, every
+    call; the in-kernel ``collective.<verb>`` sites and their telemetry
+    fire whenever the kernel is actually traced (first record of a
+    signature, and the fused program's compile) — steady-state collective
+    accounting for deferred kernels lives in the compiled program
+    (:func:`program_hlo` + ``telemetry.hlo_collective_counts``)."""
+    if DNDarray is None:
+        _resolve_siblings()
+    if not (_ENABLED and _COLLECTIVES):
+        return None
+    if isinstance(out_split, (tuple, list)):
+        return _unfused("apply", "multi_output")
+    if getattr(kernel, "_no_fusion", False):
+        return _unfused("apply", "no_fusion_op")
+    children = []
+    ndims = []
+    for x in xs:
+        if isinstance(x, DNDarray):
+            if x.padded:
+                return _unfused("apply", "padded_operand")
+            child = _phys_node(x)
+            if child is None:
+                return _unfused("apply", "tracer_payload")
+        elif isinstance(x, (jax.Array, np.ndarray)):
+            child = x
+        else:
+            return _unfused("apply", "foreign_operand")
+        children.append(child)
+        ndims.append(len(_aval(child)[0]))
+    if resilience._ARMED:
+        # record time IS dispatch time for the fault contract: the site
+        # fires per call, exactly like the eager apply, and propagates
+        resilience.check("collective.apply")
+    try:
+        fn = _apply_fn(
+            comm.mesh,
+            comm.axis_name,
+            kernel,
+            tuple(in_splits),
+            tuple(ndims),
+            out_split,
+            check_vma,
+        )
+        node = record(fn, tuple(children))
+    except Exception as exc:  # narrowed: ONE policy decides what falls back
+        if not resilience.record_recoverable(exc):
+            raise
+        return _unfused("apply", "record_failed:" + type(exc).__name__)
+    if telemetry._MODE:
+        telemetry.record_fused_collective(
+            "apply:" + getattr(kernel, "__name__", "kernel")
+        )
+    return node
+
+
+def program_hlo(x, optimized: bool = True) -> str:
+    """The (post-partitioning) HLO text of the program that would force
+    ``x``'s pending chain — the compiled-side cross-check for collective
+    accounting (``telemetry.hlo_collective_counts`` parses it). ``x`` is a
+    DNDarray with a pending payload or a pending LazyArray; lowering here
+    neither forces nor caches anything. ``optimized=False`` returns the
+    pre-optimization StableHLO instead."""
+    node = getattr(x, "_payload", x)
+    if not (isinstance(node, LazyArray) and node._value is None):
+        raise ValueError("program_hlo needs a pending recorded chain")
+    sig, leaves = _signature([node])
+    lowered = jax.jit(_build(sig)).lower(*leaves)
+    if not optimized:
+        return lowered.as_text()
+    return lowered.compile().as_text()

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import factories, sanitation, types
+from . import factories, fusion, resilience, sanitation, types
 from .communication import sanitize_comm
 from .dndarray import DNDarray, _ensure_split
 from .stride_tricks import broadcast_shape, sanitize_axis, sanitize_shape
@@ -350,13 +350,33 @@ def reshape(a: DNDarray, *shape, new_split: Optional[int] = None) -> DNDarray:
 def resplit(arr: DNDarray, axis: Optional[int] = None) -> DNDarray:
     """Out-of-place redistribution to a new split axis (reference
     manipulations.py:3329-3425: Allgatherv / SplitTiles P2P; one resharding
-    collective here)."""
+    collective here). A PENDING recorded chain stays recorded: the reshard
+    becomes a collective node in the new wrapper's DAG
+    (``fusion.defer_reshard``) while ``arr``'s own chain is untouched, so
+    the redistribution compiles inside the chain's one fused program."""
     sanitation.sanitize_in(arr)
     axis = sanitize_axis(arr.shape, axis)
     if axis == arr.split:
         from . import memory
 
         return memory.copy(arr)
+    if resilience._ARMED:
+        # same contract as resplit_: the collective.reshard site fires at
+        # record-or-dispatch time, before any wrapper is produced — an
+        # injected reshard fault must not vanish into the deferred path
+        resilience.check("collective.reshard")
+    payload = arr._payload
+    if (
+        isinstance(payload, fusion.LazyArray)
+        and payload._value is None
+        and fusion.collectives_active()
+    ):
+        node = fusion.defer_reshard(
+            payload, arr.gshape, arr.split, arr.padded, axis, arr.comm
+        )
+        if node is not None:
+            return fusion.wrap_node(node, arr.gshape, axis, arr)
+        # recording declined (breadcrumb left): force + reshard eagerly
     result = _ensure_split(arr.larray, axis, arr.comm)
     return DNDarray(result, arr.gshape, arr.dtype, axis, arr.device, arr.comm)
 

@@ -69,6 +69,11 @@ def print0(*args, **kwargs) -> None:
 def __str__(dndarray) -> str:
     """Global string representation (reference printing.py:208-264)."""
     opts = __PRINT_OPTIONS
+    if telemetry._MODE:
+        from . import fusion
+
+        if fusion.is_deferred(dndarray):  # printing a pending chain blocks
+            telemetry.record_blocking_sync("print")
     with _T_PRINT:  # a repr that forces a pending chain reads as "print"
         body = _format_data(dndarray, opts)
     return (

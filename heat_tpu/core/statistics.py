@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import factories, sanitation, types
+from . import factories, fusion, sanitation, types
 from ._operations import __binary_op as _binary_op
 from ._operations import __local_op as _local_op
 from ._operations import __reduce_op as _reduce_op
@@ -72,6 +72,28 @@ def argmin(x: DNDarray, axis: Optional[int] = None, out=None, **kwargs) -> DNDar
     return _arg_reduce(jnp.argmin, x, axis, out)
 
 
+@functools.lru_cache(maxsize=None)
+def _arg_reduce_kernel(is_max: bool, axis: int, axis_name: str, block: int, size: int):
+    """The split-crossing argmax/argmin shard_map kernel, cached per layout:
+    a STABLE function identity (unlike a per-call closure) keys the fusion
+    program cache and the retrace ledger correctly, so deferred argreduce
+    chains hit compiled code in steady state."""
+    from . import communication
+
+    red = jnp.max if is_max else jnp.min
+    arg = jnp.argmax if is_max else jnp.argmin
+    combiner = mpi_argmax if is_max else mpi_argmin
+
+    def kernel(xs):
+        lv = red(xs, axis=axis)
+        li = arg(xs, axis=axis) + jax.lax.axis_index(axis_name) * block
+        _, gi = communication.allreduce((lv, li), axis_name, op=combiner, size=size)
+        return gi
+
+    kernel.__name__ = "argmax" if is_max else "argmin"
+    return kernel
+
+
 def _arg_reduce(op, x, axis, out):
     sanitation.sanitize_in(x)
     axis = sanitize_axis(x.shape, axis)
@@ -79,24 +101,26 @@ def _arg_reduce(op, x, axis, out):
     # (value, global-index) partials merged with the mpi_argmax/mpi_argmin
     # combiner through one allreduce — the reference's custom MPI reduce op
     # (reference statistics.py:1335-1405) riding MeshCommunication.allreduce.
+    # Under collective-aware fusion the kernel records into the op-chain DAG
+    # (fusion.defer_apply) instead of dispatching its own program, so
+    # chain→argmax→chain compiles into ONE cached sharded program.
     if (
         isinstance(axis, int)
         and x.split == axis
         and not x.padded
         and x.comm.size > 1
     ):
-        import jax
-
         comm = x.comm
-        combiner = mpi_argmax if op is jnp.argmax else mpi_argmin
         block = x.shape[axis] // comm.size
-
-        def kernel(xs):
-            lv = (jnp.max if op is jnp.argmax else jnp.min)(xs, axis=axis)
-            li = op(xs, axis=axis) + jax.lax.axis_index(comm.axis_name) * block
-            _, gi = comm.allreduce((lv, li), op=combiner)
-            return gi
-
+        kernel = _arg_reduce_kernel(
+            op is jnp.argmax, axis, comm.axis_name, block, comm.size
+        )
+        if out is None and fusion.active() and fusion.collectives_active():
+            node = fusion.defer_apply(comm, kernel, (x,), (axis,), None)
+            if node is not None:
+                node = fusion.cast(node, types.index_dtype())
+                return fusion.wrap_node(node, node.shape, None, x)
+            # defer_apply left its own unfused breadcrumb: dispatch eagerly
         result = comm.apply(kernel, x.larray, in_splits=[axis], out_splits=None)
         result = result.astype(types.index_dtype())
         split = None

@@ -67,6 +67,7 @@ from typing import Any, Dict, List, Optional
 __all__ = [
     "RetraceWarning",
     "active",
+    "async_forcing",
     "checkpoint_events",
     "collective_budget_excess",
     "collective_counts",
@@ -79,12 +80,15 @@ __all__ = [
     "events",
     "force_trigger",
     "forcing_points",
+    "fused_collectives",
     "hlo_collective_counts",
     "hlo_collectives",
     "io_retries",
     "nonfinite_counts",
     "on_timer",
     "operand_bytes",
+    "record_async_dispatch",
+    "record_blocking_sync",
     "record_checkpoint",
     "record_collective",
     "record_collective_operand",
@@ -92,6 +96,7 @@ __all__ = [
     "record_degraded",
     "record_dispatch",
     "record_force",
+    "record_fused_collective",
     "record_io_retry",
     "record_nonfinite",
     "record_retrace",
@@ -186,6 +191,9 @@ _UNFUSED: Dict[str, Dict[str, int]] = {}
 _NONFINITE: Dict[str, int] = {}
 _IO_RETRIES: Dict[str, int] = {}
 _CHECKPOINT: Dict[str, int] = {}
+_FUSED_COLLECTIVES: Dict[str, int] = {}
+_ASYNC = {"dispatches": 0, "roots": 0, "multi_root_batches": 0}
+_BLOCKING: Dict[str, int] = {}
 _EVENTS: deque = deque(maxlen=_EVENT_CAP)
 
 _TRIGGER_STACK: List[str] = []
@@ -205,6 +213,9 @@ def reset() -> None:
     _NONFINITE.clear()
     _IO_RETRIES.clear()
     _CHECKPOINT.clear()
+    _FUSED_COLLECTIVES.clear()
+    _ASYNC.update(dispatches=0, roots=0, multi_root_batches=0)
+    _BLOCKING.clear()
     _EVENTS.clear()
     _SPANS.clear()
 
@@ -309,6 +320,68 @@ def collectives() -> Dict[str, Dict[str, Any]]:
             "dtypes": dict(rec["dtypes"]),
         }
         for op, rec in _COLLECTIVES.items()
+    }
+
+
+def record_fused_collective(kind: str) -> None:
+    """Count one collective NODE recorded into the fusion DAG (a deferred
+    split-crossing reduction's psum, a deferred ``reshard``, a deferred
+    ``apply:<kernel>``). These collectives execute INSIDE fused programs, so
+    :func:`collective_counts` does not see them at dispatch time — this
+    ledger counts them at record time, and ``fusion.program_hlo`` +
+    :func:`hlo_collective_counts` cross-check the compiled side."""
+    if not _MODE:
+        return
+    _FUSED_COLLECTIVES[kind] = _FUSED_COLLECTIVES.get(kind, 0) + 1
+    if _MODE >= 2:
+        _EVENTS.append({"kind": "fused_collective", "op": kind})
+
+
+def fused_collectives() -> Dict[str, int]:
+    """Per-kind counts of collective nodes recorded into fusion DAGs."""
+    return dict(_FUSED_COLLECTIVES)
+
+
+# ----------------------------------------------------------------------
+# asynchronous forcing: dispatches vs blocking syncs
+# ----------------------------------------------------------------------
+def record_async_dispatch(n_roots: int) -> None:
+    """Count one asynchronous ``fusion.force`` dispatch covering ``n_roots``
+    DAG roots (>1 = independent live roots batched into one multi-output
+    program). Dispatches install device futures without blocking."""
+    if not _MODE:
+        return
+    _ASYNC["dispatches"] += 1
+    _ASYNC["roots"] += int(n_roots)
+    if n_roots > 1:
+        _ASYNC["multi_root_batches"] += 1
+    if _MODE >= 2:
+        _EVENTS.append({"kind": "dispatch", "roots": int(n_roots)})
+
+
+def record_blocking_sync(kind: str) -> None:
+    """Count one host boundary (``item``/``numpy``/``print``/``shards``)
+    that had to synchronously materialize a PENDING chain — reads of values
+    already dispatched (in flight or done) are free and never counted. The
+    assertable surface for "this chain cost one sync"."""
+    if not _MODE:
+        return
+    _BLOCKING[kind] = _BLOCKING.get(kind, 0) + 1
+    if _MODE >= 2:
+        _EVENTS.append({"kind": "blocking_sync", "where": kind})
+
+
+def async_forcing() -> Dict[str, Any]:
+    """The async-forcing picture: program ``dispatches`` (with total
+    ``roots_dispatched`` and how many dispatches batched multiple roots)
+    versus ``blocking_syncs`` — host boundaries that synchronously forced a
+    pending chain, by kind, with their total."""
+    return {
+        "dispatches": _ASYNC["dispatches"],
+        "roots_dispatched": _ASYNC["roots"],
+        "multi_root_batches": _ASYNC["multi_root_batches"],
+        "blocking_syncs": dict(_BLOCKING),
+        "blocking_total": sum(_BLOCKING.values()),
     }
 
 
@@ -665,6 +738,8 @@ def report() -> Dict[str, Any]:
         "mode": {0: "off", 1: "on", 2: "verbose"}[_MODE],
         "collectives": collectives(),
         "collective_counts": collective_counts(),
+        "fused_collectives": fused_collectives(),
+        "async_forcing": async_forcing(),
         "forcing_points": forcing_points(),
         "dispatches": dispatches(),
         "unfused_reasons": unfused_reasons(),

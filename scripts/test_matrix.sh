@@ -24,6 +24,14 @@ done
 echo "=== fusion off (HEAT_TPU_FUSION=0) ==="
 HEAT_TPU_FUSION=0 \
   python -m pytest tests/test_elementwise.py tests/test_eager_chain.py -q -x
+# collective-fusion leg: HEAT_TPU_FUSION_COLLECTIVES=0 restores the
+# force-at-collective behavior (resplit_/apply dispatch eagerly, no
+# multi-root batching) — the escape hatch must keep the collective-spanning
+# suites green and numerically identical
+echo "=== collective fusion off (HEAT_TPU_FUSION_COLLECTIVES=0) ==="
+HEAT_TPU_FUSION_COLLECTIVES=0 \
+  python -m pytest tests/test_fused_collectives.py tests/test_eager_chain.py \
+    tests/test_statistics.py tests/test_manipulations.py -q -x
 # telemetry leg: the observability layer (HEAT_TPU_TELEMETRY=1) must change
 # no results on the instrumented suites, and the overhead guard in
 # tests/test_telemetry.py pins the enabled dispatch rate at >= 0.9x disabled
@@ -41,7 +49,8 @@ HEAT_TPU_TELEMETRY=1 \
 echo "=== faults injected (HEAT_TPU_FAULTS=ci) ==="
 HEAT_TPU_FAULTS=ci HEAT_TPU_TELEMETRY=1 \
   python -m pytest tests/test_resilience.py tests/test_resilience_io.py tests/test_io_errors.py \
-    tests/test_checkpoint_resilience.py tests/test_checkpoint_profiling.py -q -x
+    tests/test_checkpoint_resilience.py tests/test_checkpoint_profiling.py \
+    tests/test_fused_collectives.py -q -x
 # the coverage gate (reference codecov.yml target semantics): the merged
 # matrix coverage must clear the floor or the matrix run fails. On runtimes
 # without sys.monitoring (Python < 3.12) no cov_mesh*.json legs are produced

@@ -200,10 +200,21 @@ class TestFusionCache(TestCase):
             self.assertFalse(fusion.is_deferred(x))
             np.testing.assert_allclose(np.load(path), expect, rtol=1e-5)
 
-        # collective: resplit_ to a new distribution
+        # collective: resplit_ records a reshard NODE under collective-aware
+        # fusion (the chain stays pending, the redistribution compiles into
+        # its program); with collectives off it forces here as it used to
         x = chain()
-        x.resplit_(1) if x.shape[1] >= 1 else x.resplit_(None)
-        self.assertFalse(fusion.is_deferred(x))
+        x.resplit_(1)
+        if fusion.collectives_active():
+            self.assertTrue(fusion.is_deferred(x))
+        else:
+            self.assertFalse(fusion.is_deferred(x))
+        self.assertEqual(x.split, 1)
+        np.testing.assert_allclose(x.numpy(), expect, rtol=1e-5)
+        x = chain()
+        with fusion.collectives_disabled():
+            x.resplit_(1)
+            self.assertFalse(fusion.is_deferred(x))
         np.testing.assert_allclose(x.numpy(), expect, rtol=1e-5)
 
     def test_k_reductions_one_chain(self):

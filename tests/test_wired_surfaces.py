@@ -113,7 +113,12 @@ class TestCombinerRouting(TestCase):
 
         from heat_tpu.core import manipulations, statistics
 
-        self.assertIn("comm.allreduce", inspect.getsource(statistics._arg_reduce))
+        # the argreduce allreduce+combiner moved into the layout-cached
+        # shard_map kernel so deferred (fused) and eager dispatches share it
+        kernel_src = inspect.getsource(statistics._arg_reduce_kernel)
+        self.assertIn("allreduce", kernel_src)
+        self.assertIn("mpi_arg", kernel_src)
+        self.assertIn("_arg_reduce_kernel", inspect.getsource(statistics._arg_reduce))
         self.assertIn("mpi_topk", inspect.getsource(manipulations.topk))
 
 

@@ -513,6 +513,49 @@ def worker() -> None:
     if telem_new:
         print(json.dumps(record), flush=True)  # last parseable line wins
 
+    # trace-timeline leg (ISSUE 6): the full verbose event log (timestamps,
+    # correlation ids, timeline deque) against telemetry-off, in ALTERNATING
+    # best-of rounds like telemetry_overhead_pct so ambient machine noise
+    # hits both legs equally; plus one exported trace validated as Chrome
+    # trace-event JSON with its dispatch->blocking-sync async pairs counted.
+    # Runs AFTER the record is banked (hang-safety invariant).
+    try:
+        if chain_fused:
+            off_rate = verbose_rate = 0.0
+            for _ in range(3):
+                off_rate = max(off_rate, _chain_rate())
+                with _telemetry.enabled("verbose"):
+                    verbose_rate = max(verbose_rate, _chain_rate())
+            record["trace_overhead_pct"] = round(
+                100.0 * (1.0 - verbose_rate / off_rate), 1
+            )
+            import tempfile as _tempfile
+
+            with _telemetry.enabled("verbose"):
+                _telemetry.reset()
+                _reduction_chain_once()
+                with _tempfile.TemporaryDirectory() as _td:
+                    _tp = os.path.join(_td, "trace.json")
+                    _telemetry.export_trace(_tp)
+                    _problems = _telemetry.validate_trace(_tp)
+                _pairs = _telemetry.async_pairs()
+                _keys = _fusion.cache_stats()["program_keys"]
+                _correlated = sum(
+                    1 for _d, _s in _pairs if _d.get("program") in _keys
+                )
+                _telemetry.reset()
+            if _problems:
+                # an invalid export is BANKED, not raised: raising here would
+                # be eaten by this block's swallow-all and the failure would
+                # be indistinguishable from the leg never running
+                record["trace_invalid"] = [str(p) for p in _problems[:3]]
+            else:
+                record["trace_async_pairs"] = len(_pairs)
+                record["trace_pairs_with_program_key"] = _correlated
+            print(json.dumps(record), flush=True)  # last parseable line wins
+    except Exception:  # noqa: BLE001 - diagnostics must never cost the record
+        pass
+
     # guarded-dispatch overhead (core/resilience.py): the chain rate with the
     # fault harness ARMED but never firing (an exhausted times=0 spec), so
     # every injection-site check on the force/io hot paths is actually paid —

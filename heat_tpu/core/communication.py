@@ -467,6 +467,7 @@ class MeshCommunication(Communication):
         if telemetry._MODE:
             # each apply() builds (and traces) a fresh jit program — the
             # retrace ledger keys them by kernel so repeat offenders show up
+            # (record_compile also lands a "compile" event on the timeline)
             telemetry.record_compile("apply:" + getattr(kernel, "__name__", "kernel"))
         if resilience._ARMED:
             resilience.check("collective.apply")
@@ -479,6 +480,15 @@ class MeshCommunication(Communication):
                 check_vma=check_vma,
             )
         )
+        if telemetry._MODE >= 2:
+            # time the build+trace+first-execute wall on the timeline: eager
+            # apply kernels are exactly the dispatches the fused path avoids,
+            # so their cost should be visible next to the fused programs'
+            # (lazy import: utils depends on core, never the other way)
+            from ..utils.profiling import Timer
+
+            with Timer("apply:" + getattr(kernel, "__name__", "kernel"), sync=False):
+                return fn(*arrays)
         return fn(*arrays)
 
     # ------------------------------------------------------------------

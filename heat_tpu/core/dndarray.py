@@ -248,16 +248,22 @@ class DNDarray:
                 return self.parray
         return self.parray
 
-    def _note_blocking_sync(self, kind: str) -> None:
+    def _note_blocking_sync(self, kind: str):
         """Telemetry seam for host boundaries (``item``/``numpy``/shard
         reads): counted as a *blocking sync* only when a pending recorded
         chain must be materialized synchronously here — reading a value whose
         program is already dispatched (async forcing) is free and does not
-        count. One isinstance on the disabled path."""
+        count. One isinstance on the disabled path.
+
+        Carries the pending chain's correlation id into the trace timeline
+        and returns the (verbose-mode) timeline event so the call site can
+        close it via ``telemetry.end_blocking_sync`` once the host holds the
+        value — the exported trace then shows the sync's true wall duration."""
         if telemetry._MODE:
             arr = self.__array
             if isinstance(arr, fusion.LazyArray) and arr._value is None:
-                telemetry.record_blocking_sync(kind)
+                return telemetry.record_blocking_sync(kind, cid=arr.cid)
+        return None
 
     @property
     def larray(self) -> jax.Array:
@@ -629,8 +635,10 @@ class DNDarray:
     def numpy(self) -> np.ndarray:
         """Gather the global (logical) array to host numpy (reference
         dndarray.py:991-1003); padding never leaves the device."""
-        self._note_blocking_sync("numpy")
-        return np.asarray(jax.device_get(self.larray))
+        token = self._note_blocking_sync("numpy")
+        out = np.asarray(jax.device_get(self.larray))
+        telemetry.end_blocking_sync(token)
+        return out
 
     def __array__(self, dtype=None) -> np.ndarray:
         out = self.numpy()
@@ -640,8 +648,10 @@ class DNDarray:
         """The single scalar value (reference dndarray.py:965)."""
         if self.size != 1:
             raise ValueError("only one-element DNDarrays can be converted to Python scalars")
-        self._note_blocking_sync("item")
-        return self.larray.item()
+        token = self._note_blocking_sync("item")
+        out = self.larray.item()
+        telemetry.end_blocking_sync(token)
+        return out
 
     def tolist(self, keepsplit: bool = False) -> list:
         return self.numpy().tolist()

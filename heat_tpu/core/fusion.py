@@ -88,6 +88,7 @@ force-at-collective behavior (no collective nodes, no multi-root batching);
 from __future__ import annotations
 
 import functools
+import hashlib
 import itertools
 import os
 import weakref
@@ -114,7 +115,9 @@ __all__ = [
     "cache_stats",
     "clear_cache",
     "clear_quarantine",
+    "program_costs",
     "program_hlo",
+    "programs",
     "register_root",
     "wrap_node",
 ]
@@ -200,6 +203,13 @@ def collectives_disabled():
         set_collectives_enabled(prev)
 
 
+#: correlation-id source: every fresh chain takes the next id at record time;
+#: nodes recorded onto a pending chain inherit it, so one fused DAG's whole
+#: lifecycle (record -> dispatch -> blocking sync) shares a cid the trace
+#: timeline can join on (doc/internals_distribution.md: the cid contract)
+_CID_SEQ = itertools.count(1)
+
+
 class LazyArray:
     """One recorded expression-DAG node.
 
@@ -207,18 +217,20 @@ class LazyArray:
     (``jax.Array`` / ``np.ndarray``) or Python scalars; ``kw`` is the sorted
     tuple of static keyword arguments baked into the program. ``shape`` /
     ``dtype`` describe the *physical* result (inferred abstractly at record
-    time, never by executing the op).
+    time, never by executing the op). ``cid`` is the chain's correlation id
+    (inherited from the first still-pending child, else fresh).
     """
 
-    __slots__ = ("fn", "children", "kw", "shape", "dtype", "depth", "_value")
+    __slots__ = ("fn", "children", "kw", "shape", "dtype", "depth", "cid", "_value")
 
-    def __init__(self, fn, children, kw, shape, dtype, depth):
+    def __init__(self, fn, children, kw, shape, dtype, depth, cid=0):
         self.fn = fn
         self.children = children
         self.kw = kw
         self.shape = shape
         self.dtype = dtype
         self.depth = depth
+        self.cid = cid
         self._value = None
 
     @property
@@ -308,6 +320,13 @@ def record(fn, children, **kw) -> LazyArray:
             for c in children
         )
         depth = 1
+    cid = 0
+    for c in children:
+        if isinstance(c, LazyArray) and c._value is None:
+            cid = c.cid  # join the pending chain's lifecycle
+            break
+    if not cid:
+        cid = next(_CID_SEQ)
     if fn is _astype_op:
         shape = _aval(children[0])[0]
         dtype = np.dtype(kw["dtype"])
@@ -318,7 +337,11 @@ def record(fn, children, **kw) -> LazyArray:
         dtype = _aval(children[0])[1]
     else:
         shape, dtype = _infer_cached(fn, tuple(_aval(c) for c in children), kw_t)
-    return LazyArray(fn, tuple(children), kw_t, shape, dtype, depth)
+    if telemetry._MODE >= 2:
+        telemetry.record_event(
+            "record", op=getattr(fn, "__name__", str(fn)), cid=cid, depth=depth
+        )
+    return LazyArray(fn, tuple(children), kw_t, shape, dtype, depth, cid)
 
 
 def cast(c, jax_dtype) -> LazyArray:
@@ -336,6 +359,13 @@ _PROGRAMS: "OrderedDict[tuple, callable]" = OrderedDict()
 # quarantined DAG keys: signatures whose fused program failed to build or
 # execute; forced via per-op eager replay from then on (guarded forcing)
 _QUARANTINE: "OrderedDict[tuple, None]" = OrderedDict()
+# per-program accounting: sig -> {key (stable digest), family, compiles,
+# dispatches, roots}. Kept alongside _PROGRAMS (same LRU bound) so the
+# telemetry trace can name the program a dispatch launched and the cost
+# estimator can re-lower the signature on demand without holding operands.
+_PROGRAM_INFO: "OrderedDict[tuple, dict]" = OrderedDict()
+# memoized cost estimates keyed by program key (program_costs())
+_COSTS: dict = {}
 _STATS = {
     "compiles": 0,
     "hits": 0,
@@ -344,6 +374,47 @@ _STATS = {
     "degraded": 0,
     "quarantine_hits": 0,
 }
+
+
+def _program_key(sig) -> str:
+    """Stable short digest of a program signature: op names + topology +
+    static kwargs + leaf shape/dtype/sharding — the *program key* correlating
+    the trace timeline's ``dispatch`` events, ``cache_stats()["program_keys"]``
+    and :func:`program_costs`. Function identities hash by name (not id), so
+    the key is reproducible within and across processes up to sharding repr."""
+    parts = []
+    for e in sig:
+        tag = e[0]
+        if tag == "L":
+            parts.append(f"L:{e[1]}:{e[2]}:{e[3]}")
+        elif tag == "Ls":
+            parts.append(f"Ls:{getattr(e[1], '__name__', e[1])}")
+        elif tag == "R":
+            parts.append(f"R:{e[1]}")
+        else:
+            fn, idxs, kw = e
+            parts.append(f"O:{getattr(fn, '__name__', fn)}:{idxs}:{kw}")
+    return hashlib.sha1("|".join(parts).encode()).hexdigest()[:16]
+
+
+def _program_info(sig) -> dict:
+    info = _PROGRAM_INFO.get(sig)
+    if info is None:
+        info = _PROGRAM_INFO[sig] = {
+            "key": _program_key(sig),
+            "family": "/".join(_family(sig)) or "<leaf>",
+            "compiles": 0,
+            "dispatches": 0,
+            "roots": 0,
+        }
+        while len(_PROGRAM_INFO) > _CACHE_SIZE:
+            _PROGRAM_INFO.popitem(last=False)
+    else:
+        # LRU like _PROGRAMS itself: a hot program's accounting must never
+        # be the insertion-order eviction victim while its program stays
+        # cached (the counters would silently restart from zero)
+        _PROGRAM_INFO.move_to_end(sig)
+    return info
 
 
 def _leaf_sig(v):
@@ -522,6 +593,7 @@ def _degrade(sig, leaves, exc, missed):
     import warnings
 
     _PROGRAMS.pop(sig, None)
+    _PROGRAM_INFO.pop(sig, None)  # a quarantined key is not a live program
     _quarantine(sig)
     _STATS["degraded"] += 1
     stage = "compile" if missed else "execute"
@@ -580,41 +652,61 @@ def force(node):
     entries.append(("R", tuple(memo[id(r)] for r in roots)))
     sig = tuple(entries)
     _STATS["forces"] += 1
+    info = None  # per-program accounting; stays None for eager replays
     if _QUARANTINE and sig in _QUARANTINE:
         # known-bad DAG key: skip the failing compile, replay per-op
         _STATS["quarantine_hits"] += 1
         if telemetry._MODE:
-            telemetry.record_force(telemetry.current_trigger(), node.depth, compiled=False)
+            telemetry.record_force(
+                telemetry.current_trigger(), node.depth, compiled=False, cid=node.cid
+            )
         values = _build(sig)(*leaves)
     else:
         prog = _PROGRAMS.get(sig)
         missed = prog is None
+        info = _program_info(sig)
         if missed:
             prog = jax.jit(_build(sig))
             _PROGRAMS[sig] = prog
             _STATS["compiles"] += 1
+            info["compiles"] += 1
             while len(_PROGRAMS) > _CACHE_SIZE:
                 _PROGRAMS.popitem(last=False)
                 _STATS["evictions"] += 1
             if telemetry._MODE:
                 telemetry.record_retrace(_family(sig), _leaf_key(sig))
+                if telemetry._MODE >= 2:
+                    telemetry.record_event(
+                        "compile",
+                        program=info["key"], family=info["family"], cid=node.cid,
+                    )
         else:
             _PROGRAMS.move_to_end(sig)
             _STATS["hits"] += 1
         if telemetry._MODE:
-            telemetry.record_force(telemetry.current_trigger(), node.depth, compiled=missed)
+            telemetry.record_force(
+                telemetry.current_trigger(), node.depth, compiled=missed, cid=node.cid
+            )
         try:
             if resilience._ARMED:
                 # jax.jit builds lazily, so the XLA compile happens inside the
                 # first call — the injection sites model that split
                 resilience.check("fusion.compile" if missed else "fusion.execute")
             values = prog(*leaves)
+            info["dispatches"] += 1
+            info["roots"] += len(roots)
         except Exception as exc:  # noqa: BLE001 - routed through ONE policy
             if not resilience.force_recoverable(exc):
                 raise
             values = _degrade(sig, leaves, exc, missed)
+            info = None  # the eager replay is not a program dispatch
     if telemetry._MODE:
-        telemetry.record_async_dispatch(len(roots))
+        telemetry.record_async_dispatch(
+            len(roots),
+            cid=node.cid,
+            cids=[r.cid for r in roots],
+            program=None if info is None else info["key"],
+        )
     # under an enclosing trace the jit bind joins that trace and the values
     # are tracers even though every leaf is concrete (verified on jax
     # 0.4.37); caching is gated on each value's actual concreteness, not
@@ -641,22 +733,28 @@ def cache_stats() -> dict:
     """Program-cache counters: ``compiles`` (the retrace count the
     compile-count tests pin), ``hits``, ``forces``, ``misses`` (an alias of
     ``compiles`` — every miss compiles, counted once), ``evictions`` (LRU
-    drops past ``HEAT_TPU_FUSION_CACHE``), the current cache ``size``, plus
-    the guarded-forcing counters: ``degraded`` (programs that failed and
-    were replayed per-op), ``quarantine_hits`` (forces that skipped a
-    known-bad compile) and ``quarantined`` (currently quarantined keys)."""
+    drops past ``HEAT_TPU_FUSION_CACHE``), the current cache ``size``, the
+    ``program_keys`` of every cached program (the digests the trace
+    timeline's ``dispatch`` events correlate to), plus the guarded-forcing
+    counters: ``degraded`` (programs that failed and were replayed per-op),
+    ``quarantine_hits`` (forces that skipped a known-bad compile) and
+    ``quarantined`` (currently quarantined keys)."""
     return dict(
         _STATS,
         misses=_STATS["compiles"],
         size=len(_PROGRAMS),
         quarantined=len(_QUARANTINE),
+        program_keys=[info["key"] for info in _PROGRAM_INFO.values()],
     )
 
 
 def clear_cache() -> None:
-    """Drop every compiled program, lift every quarantine, forget the live
-    async-forcing root registry, and zero ALL counters coherently."""
+    """Drop every compiled program (and its accounting/cost memo), lift
+    every quarantine, forget the live async-forcing root registry, and zero
+    ALL counters coherently."""
     _PROGRAMS.clear()
+    _PROGRAM_INFO.clear()
+    _COSTS.clear()
     _QUARANTINE.clear()
     _LIVE_ROOTS.clear()
     _STATS.update(
@@ -950,7 +1048,7 @@ def defer_reshard(payload: LazyArray, gshape, split, padded, axis, comm):
             raise
         return _unfused("reshard", "record_failed:" + type(exc).__name__)
     if telemetry._MODE:
-        telemetry.record_fused_collective("reshard")
+        telemetry.record_fused_collective("reshard", cid=node.cid)
     return node
 
 
@@ -1047,9 +1145,114 @@ def defer_apply(comm, kernel, xs, in_splits, out_split, check_vma: bool = False)
         return _unfused("apply", "record_failed:" + type(exc).__name__)
     if telemetry._MODE:
         telemetry.record_fused_collective(
-            "apply:" + getattr(kernel, "__name__", "kernel")
+            "apply:" + getattr(kernel, "__name__", "kernel"), cid=node.cid
         )
     return node
+
+
+def programs() -> dict:
+    """Per-cached-program accounting keyed by program key: op ``family``,
+    ``compiles``, ``dispatches`` and total ``roots`` dispatched, with any
+    memoized :func:`program_costs` estimate merged in as ``cost``. The
+    record side of telemetry's ``report()["programs"]`` top-N block."""
+    out = {}
+    for info in _PROGRAM_INFO.values():
+        rec = {k: v for k, v in info.items() if k != "key"}
+        cost = _COSTS.get(info["key"])
+        if cost is not None:
+            rec["cost"] = dict(cost)
+        out[info["key"]] = rec
+    return out
+
+
+def _leaf_placeholder(entry):
+    """An abstract stand-in for one signature leaf: sharded
+    ``ShapeDtypeStruct`` for arrays, a zero of the recorded type for python
+    scalars — enough to AOT-lower the program without any live operand."""
+    if entry[0] == "L":
+        _, shape, dtype, sharding = entry
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+        except Exception:  # noqa: BLE001 - sharding kwarg availability varies
+            return jax.ShapeDtypeStruct(shape, dtype)
+    try:
+        return entry[1](0)
+    except Exception:  # noqa: BLE001 - exotic scalar types degrade to int
+        return 0
+
+
+def _estimate_cost(sig) -> dict:
+    """Best-effort cost estimate of one cached program, from its signature
+    alone: logical operand/result bytes from the recorded avals, flops and
+    bytes-accessed from XLA's post-compile cost analysis, and the in-program
+    collective instruction counts parsed from the optimized HLO
+    (``telemetry.hlo_collective_counts``). Re-lowers the signature from
+    abstract specs — an extra compile, which is why callers memoize."""
+    leaves = [e for e in sig if e[0] in ("L", "Ls")]
+    specs = [_leaf_placeholder(e) for e in leaves]
+    cost: dict = {
+        "operand_bytes": 0,
+        "result_bytes": None,
+        "flops": None,
+        "bytes_accessed": None,
+        "collectives": {},
+    }
+    for e in leaves:
+        if e[0] == "L":
+            size = 1
+            for s in e[1]:
+                size *= int(s)
+            cost["operand_bytes"] += size * np.dtype(e[2]).itemsize
+    try:
+        outs = jax.eval_shape(_build(sig), *specs)
+        total = 0
+        for o in jax.tree_util.tree_leaves(outs):
+            size = 1
+            for s in o.shape:
+                size *= int(s)
+            total += size * np.dtype(o.dtype).itemsize
+        cost["result_bytes"] = total
+    except Exception as exc:  # noqa: BLE001 - best-effort estimate
+        cost["error"] = repr(exc)
+        return cost
+    try:
+        compiled = jax.jit(_build(sig)).lower(*specs).compile()
+        cost["collectives"] = telemetry.hlo_collective_counts(compiled.as_text())
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else {}
+        if isinstance(analysis, dict):
+            if "flops" in analysis:
+                cost["flops"] = float(analysis["flops"])
+            if "bytes accessed" in analysis:
+                cost["bytes_accessed"] = float(analysis["bytes accessed"])
+    except Exception as exc:  # noqa: BLE001 - cost analysis is backend-dependent
+        cost["error"] = repr(exc)
+    return cost
+
+
+def program_costs(top: Optional[int] = None, refresh: bool = False) -> dict:
+    """Cost estimates for the cached sharded programs, keyed by program key
+    and ranked by dispatch count (``top`` limits how many are analyzed).
+    Estimates come from :func:`_estimate_cost` and are memoized per key
+    (``refresh=True`` recomputes); each entry also carries the program's
+    ``family`` and ``dispatches`` so flops×dispatches ranks total spend.
+    Never touches live data or forces a pending chain."""
+    ranked = sorted(
+        _PROGRAM_INFO.items(), key=lambda kv: kv[1]["dispatches"], reverse=True
+    )
+    if top is not None:
+        ranked = ranked[:top]
+    out = {}
+    for sig, info in ranked:
+        key = info["key"]
+        cost = None if refresh else _COSTS.get(key)
+        if cost is None:
+            cost = _COSTS[key] = _estimate_cost(sig)
+        out[key] = dict(
+            cost, family=info["family"], dispatches=info["dispatches"]
+        )
+    return out
 
 
 def program_hlo(x, optimized: bool = True) -> str:

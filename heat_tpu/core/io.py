@@ -177,17 +177,27 @@ def _sharded_ingest(read_block, gshape, dtype, split, device, comm) -> DNDarray:
         # NFS/GCS mount fails) only happens during the copy
         return np.asarray(read_block(sl), dtype=jdt)
 
+    read_bytes = 0
     for r, d in ranks_to_read(comm.devices):
         sl = [slice(None)] * len(gshape)
         sl[split] = slice(displs[r], displs[r] + counts[r])
         # per-block reads retry transient OSErrors (flaky NFS/GCS model;
         # injectable at "io.read") with capped exponential backoff
         local = resilience.call_with_retries("io.read", _read, tuple(sl))
+        read_bytes += local.nbytes
         if counts[r] < block:
             widths = [(0, 0)] * len(gshape)
             widths[split] = (0, block - counts[r])
             local = np.pad(local, widths)
         arrays.append(jax.device_put(local, d))
+    if telemetry._MODE >= 2:
+        # one timeline milestone per sharded ingest: block reads done, bytes
+        # on host, about to stitch (the trace shows I/O next to the programs
+        # that consume it)
+        telemetry.record_event(
+            "io", op="sharded_ingest", bytes=int(read_bytes),
+            blocks=len(arrays), split=split,
+        )
     arr = jax.make_array_from_single_device_arrays(tuple(pshape), sharding, arrays)
     return DNDarray(
         arr, tuple(gshape), types.canonical_heat_type(dtype), split, device, comm
@@ -296,7 +306,17 @@ def _rank_ordered_blocks(data: DNDarray):
             "write would be incomplete. Gather first (resplit_(None)), save "
             "per-host files, or use ht.checkpoint (per-host shard files)."
         )
-    yield from data.ranked_shards()
+    written = blocks = 0
+    for r, arr in data.ranked_shards():
+        written += arr.nbytes
+        blocks += 1
+        yield r, arr
+    if telemetry._MODE >= 2:
+        # one timeline milestone per streamed save: every shard handed to
+        # the writer (the write/rename seams stamp their own retries/faults)
+        telemetry.record_event(
+            "io", op="stream_blocks", bytes=int(written), blocks=blocks
+        )
 
 
 def _write_h5_dataset(handle, dataset: str, data: DNDarray, **kwargs):

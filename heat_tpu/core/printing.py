@@ -69,13 +69,17 @@ def print0(*args, **kwargs) -> None:
 def __str__(dndarray) -> str:
     """Global string representation (reference printing.py:208-264)."""
     opts = __PRINT_OPTIONS
+    token = None
     if telemetry._MODE:
         from . import fusion
 
         if fusion.is_deferred(dndarray):  # printing a pending chain blocks
-            telemetry.record_blocking_sync("print")
+            token = telemetry.record_blocking_sync(
+                "print", cid=dndarray._payload.cid
+            )
     with _T_PRINT:  # a repr that forces a pending chain reads as "print"
         body = _format_data(dndarray, opts)
+    telemetry.end_blocking_sync(token)
     return (
         f"DNDarray({body}, dtype=heat_tpu.{dndarray.dtype.__name__}, "
         f"device={dndarray.device}, split={dndarray.split})"

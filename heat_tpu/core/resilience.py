@@ -297,8 +297,10 @@ def check(site: str) -> None:
         if spec.matches(site) and spec.should_fire():
             spec.fired += 1
             _FIRED[site] = _FIRED.get(site, 0) + 1
-            if telemetry._MODE >= 2:
-                telemetry._EVENTS.append({"kind": "fault", "site": site, "pattern": spec.pattern})
+            if telemetry._MODE:
+                # faults are first-class trace-timeline events: the exported
+                # trace shows the degradation/retry right next to its cause
+                telemetry.record_fault(site, spec.pattern)
             raise spec.make(site)
 
 

@@ -1,5 +1,5 @@
-"""Runtime telemetry layer: collective accounting, forcing-point attribution
-and retrace detection across the engines.
+"""Runtime telemetry layer: collective accounting, forcing-point attribution,
+retrace detection, scoped sessions and the trace timeline.
 
 The reference framework ships no profiling subsystem (SURVEY.md §5) and — per
 the Dask-MPI communication study (arxiv 2101.08878) and the array
@@ -34,16 +34,58 @@ instead of leaving tests and benches to infer them from HLO dumps:
 control via :func:`set_mode`/:func:`enabled`). Disabled is the default and
 costs one module-attribute check per instrumented site — the overhead guard
 in tests/test_telemetry.py pins the telemetry-enabled eager-chain dispatch
-rate at >= 0.9x the disabled rate. ``verbose`` additionally keeps a capped
-event log (:func:`events`).
+rate at >= 0.9x the disabled rate.
+
+The trace timeline
+------------------
+``verbose`` keeps a capped, monotonic-timestamped **event log** of typed
+events (:func:`events`): ``record`` / ``compile`` / ``dispatch`` /
+``blocking_sync`` / ``collective`` / ``fused_collective`` / ``force`` /
+``degraded`` / ``fault`` / ``io_retry`` / ``io`` / ``checkpoint`` /
+``checkpoint_phase`` / ``timer`` / ``span_begin`` / ``span_end``. Events of
+one fused chain's lifecycle share a **correlation id** (``cid``, assigned at
+record time by ``core/fusion.py`` and inherited along the chain): the
+``dispatch`` event lists every batched root's cid plus the sharded-program
+key it launched (``fusion.cache_stats()["program_keys"]``), and the
+``blocking_sync`` event that waited on it carries the same cid — see
+doc/internals_distribution.md for the schema and the cid contract. The log
+is a bounded deque: truncation is *visible* as ``events_dropped`` in
+``report()["timeline"]`` (cap via ``HEAT_TPU_TELEMETRY_EVENTS``).
+
+:func:`export_trace` renders the timeline as Chrome/Perfetto trace-event
+JSON (load in ``ui.perfetto.dev`` or ``chrome://tracing``): spans and timers
+as B/E duration pairs, dispatch→blocking-sync as async (``b``/``e``) pairs
+keyed by cid, everything else as instants — one process row per host
+(``multihost.process_index()``), with :func:`merge_traces` stitching
+per-host files of a multihost run into one. ``python -m heat_tpu.telemetry``
+pretty-prints / diffs ``report_json`` artifacts and validates trace files.
+
+Scoped sessions
+---------------
+:func:`scope` opens a reentrant **telemetry session**: counters, spans and
+events recorded inside are visible *isolated* through the query functions
+(the innermost scope wins) while still rolling up into the enclosing scopes
+and the global state live — the per-session surface ROADMAP item 4's
+multi-tenant serving layer attaches to. Completed scopes are archived under
+``report()["scopes"]`` (re-entering a path accumulates).
 
 :func:`span` scopes all counters to a named region (spans nest —
 ``"fit/iter"`` paths) and integrates with ``utils/profiling.Timer``: timers
 closing inside an active span are attributed to it, and every span records
 its own wall time into the Timer registry under ``span:<path>``.
 
-:func:`report` returns the whole picture as one structured dict;
-:func:`report_json` serializes it (optionally to a file).
+:func:`report` returns the whole picture as one structured dict — including
+a ``memory`` block (``profiling.device_memory_stats`` + live-buffer bytes,
+best-effort, empty off-TPU) and a top-N ``programs`` block (per-cached-
+program dispatch counts; :func:`program_costs` adds flops / bytes-accessed /
+in-program collective estimates from each program's HLO, on demand because
+the estimate compiles). :func:`report_json` serializes it deterministically
+(tuple keys are joined, sets sorted — never ``default=str`` drift);
+``HEAT_TPU_METRICS=<path>`` streams it as JSON-lines periodically and at
+exit (:func:`set_metrics_sink`) so long jobs are observable externally.
+
+Event emission stays near-zero-cost when ``HEAT_TPU_TELEMETRY=0`` and never
+forces a pending chain or adds a blocking sync of its own.
 
 The module also owns the *compiled-program* side of collective accounting:
 :func:`hlo_collectives` / :func:`hlo_collective_counts` parse an XLA HLO
@@ -55,9 +97,11 @@ linalg suites used to carry.
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import re
+import threading
 import time
 import warnings
 from collections import deque
@@ -77,16 +121,21 @@ __all__ = [
     "degraded_counts",
     "dispatches",
     "enabled",
+    "end_blocking_sync",
     "events",
+    "export_trace",
+    "fault_events",
     "force_trigger",
     "forcing_points",
     "fused_collectives",
     "hlo_collective_counts",
     "hlo_collectives",
     "io_retries",
+    "merge_traces",
     "nonfinite_counts",
     "on_timer",
     "operand_bytes",
+    "program_costs",
     "record_async_dispatch",
     "record_blocking_sync",
     "record_checkpoint",
@@ -95,6 +144,8 @@ __all__ = [
     "record_compile",
     "record_degraded",
     "record_dispatch",
+    "record_event",
+    "record_fault",
     "record_force",
     "record_fused_collective",
     "record_io_retry",
@@ -105,10 +156,15 @@ __all__ = [
     "report_json",
     "reset",
     "retraces",
+    "scope",
+    "scope_reports",
+    "set_metrics_sink",
     "set_mode",
     "span",
     "spans",
+    "trace_events",
     "unfused_reasons",
+    "validate_trace",
     "verbose",
 ]
 
@@ -147,7 +203,13 @@ _MODE = _parse_mode(os.environ.get("HEAT_TPU_TELEMETRY", "0"))
 #: step — is the churn pathology, so the default sits well above warmup.
 _RETRACE_WARN_AFTER = int(os.environ.get("HEAT_TPU_TELEMETRY_RETRACE_WARN", "8"))
 
-_EVENT_CAP = 1024
+#: trace-timeline event cap per state (global and per scope). Overflow drops
+#: the OLDEST events and counts them (``report()["timeline"]["events_dropped"]``)
+#: — truncation is visible, never silent.
+_EVENT_CAP = int(os.environ.get("HEAT_TPU_TELEMETRY_EVENTS", "8192"))
+
+#: programs shown in ``report()["programs"]`` (ranked by dispatch count)
+_TOP_PROGRAMS = int(os.environ.get("HEAT_TPU_TELEMETRY_TOP_PROGRAMS", "5"))
 
 
 def active() -> bool:
@@ -156,7 +218,7 @@ def active() -> bool:
 
 
 def verbose() -> bool:
-    """Whether the capped per-event log is kept (``HEAT_TPU_TELEMETRY=verbose``)."""
+    """Whether the trace timeline is kept (``HEAT_TPU_TELEMETRY=verbose``)."""
     return _MODE >= 2
 
 
@@ -179,45 +241,260 @@ def enabled(mode=1):
 
 
 # ----------------------------------------------------------------------
-# counter state
+# counter state: one _State per telemetry session
 # ----------------------------------------------------------------------
-_COLLECTIVES: Dict[str, Dict[str, Any]] = {}
-_FORCES: Dict[str, Dict[str, Any]] = {}
-_RETRACES: Dict[tuple, Dict[str, Any]] = {}
-_COMPILES: Dict[str, int] = {}
-_DISPATCHES: Dict[str, Dict[str, int]] = {}
-_DEGRADED: Dict[str, Dict[str, Any]] = {}
-_UNFUSED: Dict[str, Dict[str, int]] = {}
-_NONFINITE: Dict[str, int] = {}
-_IO_RETRIES: Dict[str, int] = {}
-_CHECKPOINT: Dict[str, int] = {}
-_FUSED_COLLECTIVES: Dict[str, int] = {}
-_ASYNC = {"dispatches": 0, "roots": 0, "multi_root_batches": 0}
-_BLOCKING: Dict[str, int] = {}
-_EVENTS: deque = deque(maxlen=_EVENT_CAP)
+class _State:
+    """One isolated set of telemetry counters + an event deque.
+
+    The module keeps a stack of these: ``_STATES[0]`` is the global state
+    and every active :func:`scope` pushes its own. Record functions write to
+    EVERY state on the stack (so scopes roll up live); query functions read
+    the INNERMOST (so scopes are isolated)."""
+
+    __slots__ = (
+        "path", "t0", "wall_s", "calls", "collectives", "forces", "retraces",
+        "compiles", "dispatches", "degraded", "unfused", "nonfinite",
+        "io_retries", "checkpoint", "fused_collectives", "async_", "blocking",
+        "faults", "spans", "events", "events_dropped",
+    )
+
+    def __init__(self, path: str = ""):
+        self.path = path
+        self.calls = 1
+        self.wall_s = 0.0
+        self.clear()
+
+    def clear(self) -> None:
+        self.t0 = time.perf_counter()
+        self.collectives: Dict[str, Dict[str, Any]] = {}
+        self.forces: Dict[str, Dict[str, Any]] = {}
+        self.retraces: Dict[tuple, Dict[str, Any]] = {}
+        self.compiles: Dict[str, int] = {}
+        self.dispatches: Dict[str, Dict[str, int]] = {}
+        self.degraded: Dict[str, Dict[str, Any]] = {}
+        self.unfused: Dict[str, Dict[str, int]] = {}
+        self.nonfinite: Dict[str, int] = {}
+        self.io_retries: Dict[str, int] = {}
+        self.checkpoint: Dict[str, int] = {}
+        self.fused_collectives: Dict[str, int] = {}
+        self.async_ = {"dispatches": 0, "roots": 0, "multi_root_batches": 0}
+        self.blocking: Dict[str, int] = {}
+        self.faults: Dict[str, int] = {}
+        self.spans: Dict[str, Dict[str, Any]] = {}
+        self.events: deque = deque(maxlen=_EVENT_CAP)
+        self.events_dropped = 0
+
+    def append_event(self, ev: dict) -> None:
+        if self.events.maxlen is not None and len(self.events) == self.events.maxlen:
+            self.events_dropped += 1
+        self.events.append(ev)
+
+
+def _add_int(dst: Dict[str, int], src: Dict[str, int]) -> None:
+    for k, v in src.items():
+        dst[k] = dst.get(k, 0) + v
+
+
+def _merge_state(dst: _State, src: _State) -> None:
+    """Accumulate ``src`` into ``dst`` (the completed-scope rollup)."""
+    for op, rec in src.collectives.items():
+        d = dst.collectives.setdefault(op, {"count": 0, "bytes": 0, "axes": {}, "dtypes": {}})
+        d["count"] += rec["count"]
+        d["bytes"] += rec["bytes"]
+        _add_int(d["axes"], rec["axes"])
+        _add_int(d["dtypes"], rec["dtypes"])
+    for trig, rec in src.forces.items():
+        d = dst.forces.setdefault(trig, {"count": 0, "depth_total": 0, "max_depth": 0, "compiles": 0})
+        d["count"] += rec["count"]
+        d["depth_total"] += rec["depth_total"]
+        d["max_depth"] = max(d["max_depth"], rec["max_depth"])
+        d["compiles"] += rec["compiles"]
+    for fam, rec in src.retraces.items():
+        d = dst.retraces.setdefault(fam, {"misses": 0, "keys": set(), "warned": False})
+        d["misses"] += rec["misses"]
+        if not d["warned"]:
+            # bounded union: the set exists to cross the warn threshold, so
+            # archived scopes never need (and must never hold) more keys —
+            # re-entered scopes under shape churn would otherwise leak
+            for key in rec["keys"]:
+                if len(d["keys"]) >= _RETRACE_WARN_AFTER:
+                    d["warned"] = True
+                    break
+                d["keys"].add(key)
+        d["warned"] = d["warned"] or rec["warned"]
+    _add_int(dst.compiles, src.compiles)
+    for eng, rec in src.dispatches.items():
+        _add_int(dst.dispatches.setdefault(eng, {}), rec)
+    for key, rec in src.degraded.items():
+        d = dst.degraded.setdefault(key, {"count": 0, "stages": {}, "last_error": ""})
+        d["count"] += rec["count"]
+        _add_int(d["stages"], rec["stages"])
+        d["last_error"] = rec["last_error"] or d["last_error"]
+    for eng, rec in src.unfused.items():
+        _add_int(dst.unfused.setdefault(eng, {}), rec)
+    _add_int(dst.nonfinite, src.nonfinite)
+    _add_int(dst.io_retries, src.io_retries)
+    _add_int(dst.checkpoint, src.checkpoint)
+    _add_int(dst.fused_collectives, src.fused_collectives)
+    _add_int(dst.async_, src.async_)
+    _add_int(dst.blocking, src.blocking)
+    _add_int(dst.faults, src.faults)
+    for path, rec in src.spans.items():
+        d = dst.spans.setdefault(
+            path, {"calls": 0, "total_s": 0.0, "collectives": {}, "forces": 0, "retraces": 0, "timers": {}}
+        )
+        d["calls"] += rec["calls"]
+        d["total_s"] += rec["total_s"]
+        d["forces"] += rec["forces"]
+        d["retraces"] += rec["retraces"]
+        _add_int(d["collectives"], rec["collectives"])
+        for t, s in rec["timers"].items():
+            d["timers"][t] = d["timers"].get(t, 0.0) + s
+    for ev in src.events:
+        dst.append_event(ev)
+    dst.events_dropped += src.events_dropped
+    dst.wall_s += src.wall_s
+    dst.calls += src.calls
+
+
+_GLOBAL = _State()
+#: every state currently recording: the global one + the active scope stack
+_STATES: List[_State] = [_GLOBAL]
+#: active scopes only (innermost last)
+_SCOPE_STACK: List[_State] = []
+#: completed-scope accumulators, keyed by scope path (re-entry accumulates)
+_SCOPES: Dict[str, _State] = {}
 
 _TRIGGER_STACK: List[str] = []
 _SPAN_STACK: list = []
-_SPANS: Dict[str, Dict[str, Any]] = {}
+
+
+def _cur() -> _State:
+    return _STATES[-1]
 
 
 def reset() -> None:
-    """Clear every counter, span and event (the mode is left untouched)."""
-    _COLLECTIVES.clear()
-    _FORCES.clear()
-    _RETRACES.clear()
-    _COMPILES.clear()
-    _DISPATCHES.clear()
-    _DEGRADED.clear()
-    _UNFUSED.clear()
-    _NONFINITE.clear()
-    _IO_RETRIES.clear()
-    _CHECKPOINT.clear()
-    _FUSED_COLLECTIVES.clear()
-    _ASYNC.update(dispatches=0, roots=0, multi_root_batches=0)
-    _BLOCKING.clear()
-    _EVENTS.clear()
-    _SPANS.clear()
+    """Clear every counter, span, event and completed scope of every active
+    state, and reset the ``utils/profiling`` timer registry with them (the
+    two report surfaces are joined — ``report()`` merges timers in, so a
+    reset that left them stale would mislabel the next bench's report). The
+    mode is left untouched; active :func:`scope`/:func:`span` stacks keep
+    recording."""
+    for st in _STATES:
+        st.clear()
+    _SCOPES.clear()
+    try:
+        from ..utils import profiling
+
+        profiling.reset()
+    except Exception:  # pragma: no cover - import-order safety only
+        pass
+
+
+# ----------------------------------------------------------------------
+# the trace timeline: typed, monotonic-timestamped events
+# ----------------------------------------------------------------------
+def _emit(kind: str, **fields) -> dict:
+    """Append one typed event to every active state's timeline. Callers gate
+    on ``_MODE >= 2``; the event carries a monotonic ``ts`` (perf_counter
+    seconds — the exporter converts to trace microseconds) and the innermost
+    scope path when a scope is active."""
+    ev: Dict[str, Any] = {"kind": kind, "ts": time.perf_counter()}
+    ev.update(fields)
+    if _SCOPE_STACK:
+        ev["scope"] = _SCOPE_STACK[-1].path
+    for st in _STATES:
+        st.append_event(ev)
+    return ev
+
+
+def record_event(kind: str, **fields) -> Optional[dict]:
+    """Emit one typed trace-timeline event (no counter side effects). The
+    public seam for subsystems with lifecycle phases worth a timestamp but
+    no counter (checkpoint phases, io ingest milestones). No-op unless
+    ``HEAT_TPU_TELEMETRY=verbose``; returns the (mutable) event dict."""
+    if _MODE < 2:
+        return None
+    return _emit(kind, **fields)
+
+
+def events() -> List[dict]:
+    """The capped timeline of the innermost active state (empty unless
+    ``HEAT_TPU_TELEMETRY=verbose``)."""
+    return list(_cur().events)
+
+
+# ----------------------------------------------------------------------
+# scoped telemetry sessions
+# ----------------------------------------------------------------------
+@contextmanager
+def scope(name: str):
+    """Open an isolated telemetry session named ``name``.
+
+    Counters/spans/events recorded inside are visible through the query
+    functions as the scope's OWN (isolation) while also recording into every
+    enclosing scope and the global state live (rollup) — so a multi-tenant
+    server can meter one session without losing the fleet-wide picture.
+    Scopes are reentrant and nest (paths join as ``outer/inner``); on exit
+    the session is archived under ``report()["scopes"][path]``, re-entering
+    the same path accumulates (``calls`` counts entries). Yields the scope
+    path, or None when telemetry is off."""
+    if not _MODE:
+        yield None
+        return
+    path = (_SCOPE_STACK[-1].path + "/" + str(name)) if _SCOPE_STACK else str(name)
+    st = _State(path)
+    _SCOPE_STACK.append(st)
+    _STATES.append(st)
+    try:
+        yield path
+    finally:
+        st.wall_s = time.perf_counter() - st.t0
+        # remove by identity: reset()/nesting must never pop the wrong frame
+        for lst in (_STATES, _SCOPE_STACK):
+            for i in range(len(lst) - 1, -1, -1):
+                if lst[i] is st:
+                    del lst[i]
+                    break
+        acc = _SCOPES.get(path)
+        if acc is None:
+            acc = _SCOPES[path] = _State(path)
+            acc.calls = 0
+            acc.wall_s = 0.0
+        _merge_state(acc, st)
+
+
+def _scope_doc(st: _State) -> Dict[str, Any]:
+    """One archived scope rendered report-shaped."""
+    return {
+        "calls": st.calls,
+        "wall_s": st.wall_s,
+        "collectives": _render_collectives(st),
+        "collective_counts": {op: rec["count"] for op, rec in st.collectives.items()},
+        "fused_collectives": dict(st.fused_collectives),
+        "async_forcing": _render_async(st),
+        "forcing_points": _render_forces(st),
+        "dispatches": {k: dict(v) for k, v in st.dispatches.items()},
+        "unfused_reasons": {k: dict(v) for k, v in st.unfused.items()},
+        "retraces": _render_retraces(st),
+        "degraded": _render_degraded(st),
+        "nonfinite": dict(st.nonfinite),
+        "io_retries": dict(st.io_retries),
+        "checkpoint": dict(st.checkpoint),
+        "faults": dict(st.faults),
+        "jit_compiles": dict(st.compiles),
+        "spans": _render_spans(st),
+        "timeline": {
+            "events": len(st.events),
+            "events_dropped": st.events_dropped,
+            "cap": _EVENT_CAP,
+        },
+    }
+
+
+def scope_reports() -> Dict[str, Dict[str, Any]]:
+    """Every completed scope's archived counters, keyed by scope path."""
+    return {path: _scope_doc(acc) for path, acc in _SCOPES.items()}
 
 
 # ----------------------------------------------------------------------
@@ -273,6 +550,18 @@ def record_collective_operand(op: str, axis: Optional[str], x, count: int = 1) -
     record_collective(op, axis, total, dtype, count)
 
 
+def _in_trace() -> bool:
+    """Whether a jax trace is active right now (verbose events only: a
+    collective recorded from inside a ``shard_map`` kernel is stamped at
+    TRACE time, not execution time — the timeline marks it so)."""
+    try:
+        import jax
+
+        return not jax.core.trace_state_clean()
+    except Exception:  # pragma: no cover - jax-version safety
+        return False
+
+
 def record_collective(
     op: str,
     axis: Optional[str] = None,
@@ -285,33 +574,28 @@ def record_collective(
     declared linalg schedules; no-op when telemetry is off."""
     if not _MODE:
         return
-    rec = _COLLECTIVES.get(op)
-    if rec is None:
-        rec = _COLLECTIVES[op] = {"count": 0, "bytes": 0, "axes": {}, "dtypes": {}}
-    rec["count"] += count
-    rec["bytes"] += int(nbytes) * count
-    if axis is not None:
-        rec["axes"][axis] = rec["axes"].get(axis, 0) + count
-    if dtype is not None:
-        rec["dtypes"][dtype] = rec["dtypes"].get(dtype, 0) + count
+    for st in _STATES:
+        rec = st.collectives.get(op)
+        if rec is None:
+            rec = st.collectives[op] = {"count": 0, "bytes": 0, "axes": {}, "dtypes": {}}
+        rec["count"] += count
+        rec["bytes"] += int(nbytes) * count
+        if axis is not None:
+            rec["axes"][axis] = rec["axes"].get(axis, 0) + count
+        if dtype is not None:
+            rec["dtypes"][dtype] = rec["dtypes"].get(dtype, 0) + count
     if _MODE >= 2:
-        _EVENTS.append(
-            {"kind": "collective", "op": op, "axis": axis, "bytes": int(nbytes), "dtype": dtype, "count": count}
+        _emit(
+            "collective",
+            op=op, axis=axis, bytes=int(nbytes), dtype=dtype, count=count,
+            traced=_in_trace(),
         )
     if _SPAN_STACK:
         for frame in _SPAN_STACK:
             frame.collectives[op] = frame.collectives.get(op, 0) + count
 
 
-def collective_counts() -> Dict[str, int]:
-    """Per-type logical collective counts — the assertable surface for tests
-    and benches: ``{"allreduce": 3, "allgather": 1, ...}``."""
-    return {op: rec["count"] for op, rec in _COLLECTIVES.items()}
-
-
-def collectives() -> Dict[str, Dict[str, Any]]:
-    """Full per-type accounting: count, bytes moved, per-axis and per-dtype
-    breakdowns."""
+def _render_collectives(st: _State) -> Dict[str, Dict[str, Any]]:
     return {
         op: {
             "count": rec["count"],
@@ -319,11 +603,24 @@ def collectives() -> Dict[str, Dict[str, Any]]:
             "axes": dict(rec["axes"]),
             "dtypes": dict(rec["dtypes"]),
         }
-        for op, rec in _COLLECTIVES.items()
+        for op, rec in st.collectives.items()
     }
 
 
-def record_fused_collective(kind: str) -> None:
+def collective_counts() -> Dict[str, int]:
+    """Per-type logical collective counts — the assertable surface for tests
+    and benches: ``{"allreduce": 3, "allgather": 1, ...}``. Inside a
+    :func:`scope` this is the scope's own isolated view."""
+    return {op: rec["count"] for op, rec in _cur().collectives.items()}
+
+
+def collectives() -> Dict[str, Dict[str, Any]]:
+    """Full per-type accounting: count, bytes moved, per-axis and per-dtype
+    breakdowns."""
+    return _render_collectives(_cur())
+
+
+def record_fused_collective(kind: str, cid: Optional[int] = None) -> None:
     """Count one collective NODE recorded into the fusion DAG (a deferred
     split-crossing reduction's psum, a deferred ``reshard``, a deferred
     ``apply:<kernel>``). These collectives execute INSIDE fused programs, so
@@ -332,43 +629,78 @@ def record_fused_collective(kind: str) -> None:
     :func:`hlo_collective_counts` cross-check the compiled side."""
     if not _MODE:
         return
-    _FUSED_COLLECTIVES[kind] = _FUSED_COLLECTIVES.get(kind, 0) + 1
+    for st in _STATES:
+        st.fused_collectives[kind] = st.fused_collectives.get(kind, 0) + 1
     if _MODE >= 2:
-        _EVENTS.append({"kind": "fused_collective", "op": kind})
+        _emit("fused_collective", op=kind, cid=cid)
 
 
 def fused_collectives() -> Dict[str, int]:
     """Per-kind counts of collective nodes recorded into fusion DAGs."""
-    return dict(_FUSED_COLLECTIVES)
+    return dict(_cur().fused_collectives)
 
 
 # ----------------------------------------------------------------------
 # asynchronous forcing: dispatches vs blocking syncs
 # ----------------------------------------------------------------------
-def record_async_dispatch(n_roots: int) -> None:
+def record_async_dispatch(
+    n_roots: int,
+    cid: Optional[int] = None,
+    cids=(),
+    program: Optional[str] = None,
+) -> None:
     """Count one asynchronous ``fusion.force`` dispatch covering ``n_roots``
     DAG roots (>1 = independent live roots batched into one multi-output
-    program). Dispatches install device futures without blocking."""
+    program). Dispatches install device futures without blocking. ``cid`` is
+    the triggering chain's correlation id, ``cids`` every batched root's,
+    ``program`` the sharded-program key launched (None for degraded/
+    quarantined replays) — the timeline event links the whole lifecycle."""
     if not _MODE:
         return
-    _ASYNC["dispatches"] += 1
-    _ASYNC["roots"] += int(n_roots)
-    if n_roots > 1:
-        _ASYNC["multi_root_batches"] += 1
+    for st in _STATES:
+        st.async_["dispatches"] += 1
+        st.async_["roots"] += int(n_roots)
+        if n_roots > 1:
+            st.async_["multi_root_batches"] += 1
     if _MODE >= 2:
-        _EVENTS.append({"kind": "dispatch", "roots": int(n_roots)})
+        _emit("dispatch", roots=int(n_roots), cid=cid, cids=list(cids), program=program)
 
 
-def record_blocking_sync(kind: str) -> None:
+def record_blocking_sync(kind: str, cid: Optional[int] = None) -> Optional[dict]:
     """Count one host boundary (``item``/``numpy``/``print``/``shards``)
     that had to synchronously materialize a PENDING chain — reads of values
     already dispatched (in flight or done) are free and never counted. The
-    assertable surface for "this chain cost one sync"."""
+    assertable surface for "this chain cost one sync".
+
+    ``cid`` is the pending chain's correlation id. Returns the timeline
+    event (verbose mode) so the call site can close it with
+    :func:`end_blocking_sync` once the host actually holds the value — the
+    event then carries the true wall duration of the sync."""
     if not _MODE:
-        return
-    _BLOCKING[kind] = _BLOCKING.get(kind, 0) + 1
+        return None
+    for st in _STATES:
+        st.blocking[kind] = st.blocking.get(kind, 0) + 1
     if _MODE >= 2:
-        _EVENTS.append({"kind": "blocking_sync", "where": kind})
+        return _emit("blocking_sync", where=kind, cid=cid)
+    return None
+
+
+def end_blocking_sync(token: Optional[dict]) -> None:
+    """Close a blocking-sync timeline event returned by
+    :func:`record_blocking_sync`: stamps the wall ``dur`` the host boundary
+    spent from noting the pending chain to holding the materialized value."""
+    if token is not None:
+        token["dur"] = time.perf_counter() - token["ts"]
+
+
+def _render_async(st: _State) -> Dict[str, Any]:
+    return {
+        "dispatches": st.async_["dispatches"],
+        "roots_dispatched": st.async_["roots"],
+        "multi_root_batches": st.async_["multi_root_batches"],
+        "blocking_syncs": dict(st.blocking),
+        "blocking_total": sum(st.blocking.values()),
+    }
 
 
 def async_forcing() -> Dict[str, Any]:
@@ -376,13 +708,7 @@ def async_forcing() -> Dict[str, Any]:
     ``roots_dispatched`` and how many dispatches batched multiple roots)
     versus ``blocking_syncs`` — host boundaries that synchronously forced a
     pending chain, by kind, with their total."""
-    return {
-        "dispatches": _ASYNC["dispatches"],
-        "roots_dispatched": _ASYNC["roots"],
-        "multi_root_batches": _ASYNC["multi_root_batches"],
-        "blocking_syncs": dict(_BLOCKING),
-        "blocking_total": sum(_BLOCKING.values()),
-    }
+    return _render_async(_cur())
 
 
 # ----------------------------------------------------------------------
@@ -411,10 +737,10 @@ _TRIGGER_SCOPES: Dict[str, _TriggerScope] = {}
 
 def force_trigger(name: str) -> _TriggerScope:
     """The (cached, reusable) attribution scope for forcing trigger ``name``."""
-    scope = _TRIGGER_SCOPES.get(name)
-    if scope is None:
-        scope = _TRIGGER_SCOPES[name] = _TriggerScope(name)
-    return scope
+    scope_ = _TRIGGER_SCOPES.get(name)
+    if scope_ is None:
+        scope_ = _TRIGGER_SCOPES[name] = _TriggerScope(name)
+    return scope_
 
 
 def current_trigger() -> str:
@@ -423,33 +749,33 @@ def current_trigger() -> str:
     return _TRIGGER_STACK[0] if _TRIGGER_STACK else "parray"
 
 
-def record_force(trigger: str, depth: int, compiled: bool = False) -> None:
+def record_force(trigger: str, depth: int, compiled: bool = False, cid: Optional[int] = None) -> None:
     """Record one materialized chain: ``trigger`` names the forcing point,
     ``depth`` the recorded chain depth dispatched, ``compiled`` whether this
-    force paid a fresh XLA compile (cache miss)."""
+    force paid a fresh XLA compile (cache miss), ``cid`` the chain's
+    correlation id."""
     if not _MODE:
         return
-    rec = _FORCES.get(trigger)
-    if rec is None:
-        rec = _FORCES[trigger] = {"count": 0, "depth_total": 0, "max_depth": 0, "compiles": 0}
-    rec["count"] += 1
-    rec["depth_total"] += int(depth)
-    if depth > rec["max_depth"]:
-        rec["max_depth"] = int(depth)
-    if compiled:
-        rec["compiles"] += 1
+    for st in _STATES:
+        rec = st.forces.get(trigger)
+        if rec is None:
+            rec = st.forces[trigger] = {"count": 0, "depth_total": 0, "max_depth": 0, "compiles": 0}
+        rec["count"] += 1
+        rec["depth_total"] += int(depth)
+        if depth > rec["max_depth"]:
+            rec["max_depth"] = int(depth)
+        if compiled:
+            rec["compiles"] += 1
     if _MODE >= 2:
-        _EVENTS.append({"kind": "force", "trigger": trigger, "depth": int(depth), "compiled": compiled})
+        _emit("force", trigger=trigger, depth=int(depth), compiled=compiled, cid=cid)
     if _SPAN_STACK:
         for frame in _SPAN_STACK:
             frame.forces += 1
 
 
-def forcing_points() -> Dict[str, Dict[str, Any]]:
-    """Per-trigger forcing histogram: count, mean/max chain depth forced,
-    and how many of those forces paid a compile."""
+def _render_forces(st: _State) -> Dict[str, Dict[str, Any]]:
     out = {}
-    for trigger, rec in _FORCES.items():
+    for trigger, rec in st.forces.items():
         out[trigger] = {
             "count": rec["count"],
             "mean_depth": round(rec["depth_total"] / rec["count"], 2) if rec["count"] else 0.0,
@@ -459,6 +785,12 @@ def forcing_points() -> Dict[str, Dict[str, Any]]:
     return out
 
 
+def forcing_points() -> Dict[str, Dict[str, Any]]:
+    """Per-trigger forcing histogram: count, mean/max chain depth forced,
+    and how many of those forces paid a compile."""
+    return _render_forces(_cur())
+
+
 # ----------------------------------------------------------------------
 # compile / retrace tracking
 # ----------------------------------------------------------------------
@@ -466,27 +798,40 @@ def record_retrace(family: tuple, shape_key) -> None:
     """Record a fusion-cache miss for op ``family`` (the DAG's op identities)
     under leaf-shape signature ``shape_key``. When one family accumulates
     ``_RETRACE_WARN_AFTER`` distinct shape signatures, a
-    :class:`RetraceWarning` fires — exactly once per family."""
+    :class:`RetraceWarning` fires — exactly once per family (the warn
+    decision reads the GLOBAL ledger, so scopes never re-warn)."""
     if not _MODE:
         return
-    rec = _RETRACES.get(family)
-    if rec is None:
-        rec = _RETRACES[family] = {"misses": 0, "keys": set(), "warned": False}
-    rec["misses"] += 1
-    if not rec["warned"]:
-        # the key set only exists to cross the warn threshold; once warned,
-        # ``misses`` tracks volume and the set stops growing (shape churn is
-        # exactly the case that would otherwise accumulate keys unboundedly)
-        rec["keys"].add(shape_key)
+    grec0 = _GLOBAL.retraces.get(family)
+    already_warned = grec0 is not None and grec0["warned"]
+    for st in _STATES:
+        rec = st.retraces.get(family)
+        if rec is None:
+            # a family the GLOBAL ledger already warned on starts warned in
+            # every fresh scope state too — otherwise per-request scopes
+            # under shape churn would re-accumulate keys forever
+            rec = st.retraces[family] = {"misses": 0, "keys": set(), "warned": already_warned}
+        rec["misses"] += 1
+        if not rec["warned"] and not already_warned:
+            # the key set only exists to cross the warn threshold; once warned,
+            # ``misses`` tracks volume and the set stops growing (shape churn is
+            # exactly the case that would otherwise accumulate keys unboundedly)
+            rec["keys"].add(shape_key)
     if _SPAN_STACK:
         for frame in _SPAN_STACK:
             frame.retraces += 1
-    if not rec["warned"] and len(rec["keys"]) >= _RETRACE_WARN_AFTER:
-        rec["warned"] = True
+    grec = _GLOBAL.retraces.get(family)
+    if grec is None:  # reset() raced the loop above; nothing to warn on
+        return
+    if not grec["warned"] and len(grec["keys"]) >= _RETRACE_WARN_AFTER:
+        for st in _STATES:
+            rec = st.retraces.get(family)
+            if rec is not None:
+                rec["warned"] = True
         warnings.warn(
             RetraceWarning(
                 f"op family {'/'.join(family) or '<leaf>'} recompiled under "
-                f"{len(rec['keys'])} distinct input shapes ({rec['misses']} cache "
+                f"{len(grec['keys'])} distinct input shapes ({grec['misses']} cache "
                 "misses): shape churn is defeating the fusion program cache — pad "
                 "or bucket the varying dimension, or force the chain before the "
                 "shape-dependent step"
@@ -495,24 +840,31 @@ def record_retrace(family: tuple, shape_key) -> None:
         )
 
 
-def retraces() -> Dict[str, Dict[str, Any]]:
-    """Per-op-family fusion-cache miss accounting."""
+def _render_retraces(st: _State) -> Dict[str, Dict[str, Any]]:
     return {
         "/".join(family) or "<leaf>": {
             "misses": rec["misses"],
             "distinct_shapes": len(rec["keys"]),
             "warned": rec["warned"],
         }
-        for family, rec in _RETRACES.items()
+        for family, rec in st.retraces.items()
     }
 
 
-def record_compile(label: str) -> None:
+def retraces() -> Dict[str, Dict[str, Any]]:
+    """Per-op-family fusion-cache miss accounting."""
+    return _render_retraces(_cur())
+
+
+def record_compile(label: str, cid: Optional[int] = None) -> None:
     """Count a jit program build outside the fusion cache (e.g. one
     ``MeshCommunication.apply`` kernel), keyed by kernel label."""
     if not _MODE:
         return
-    _COMPILES[label] = _COMPILES.get(label, 0) + 1
+    for st in _STATES:
+        st.compiles[label] = st.compiles.get(label, 0) + 1
+    if _MODE >= 2:
+        _emit("compile", label=label, cid=cid)
 
 
 # ----------------------------------------------------------------------
@@ -523,15 +875,17 @@ def record_dispatch(engine: str, fused: bool) -> None:
     as deferred-into-the-DAG (``fused``) or eager."""
     if not _MODE:
         return
-    rec = _DISPATCHES.get(engine)
-    if rec is None:
-        rec = _DISPATCHES[engine] = {"fused": 0, "eager": 0}
-    rec["fused" if fused else "eager"] += 1
+    key = "fused" if fused else "eager"
+    for st in _STATES:
+        rec = st.dispatches.get(engine)
+        if rec is None:
+            rec = st.dispatches[engine] = {"fused": 0, "eager": 0}
+        rec[key] += 1
 
 
 def dispatches() -> Dict[str, Dict[str, int]]:
     """Per-engine fused-vs-eager dispatch counts."""
-    return {k: dict(v) for k, v in _DISPATCHES.items()}
+    return {k: dict(v) for k, v in _cur().dispatches.items()}
 
 
 def record_unfused(engine: str, reason: str) -> None:
@@ -541,16 +895,17 @@ def record_unfused(engine: str, reason: str) -> None:
     shows *why* a chain wasn't fused, not just that it wasn't."""
     if not _MODE:
         return
-    rec = _UNFUSED.get(engine)
-    if rec is None:
-        rec = _UNFUSED[engine] = {}
-    rec[reason] = rec.get(reason, 0) + 1
+    for st in _STATES:
+        rec = st.unfused.get(engine)
+        if rec is None:
+            rec = st.unfused[engine] = {}
+        rec[reason] = rec.get(reason, 0) + 1
 
 
 def unfused_reasons() -> Dict[str, Dict[str, int]]:
     """Per-engine reasons ops fell back to the eager engine instead of
     deferring into the fusion DAG."""
-    return {k: dict(v) for k, v in _UNFUSED.items()}
+    return {k: dict(v) for k, v in _cur().unfused.items()}
 
 
 # ----------------------------------------------------------------------
@@ -563,61 +918,86 @@ def record_degraded(family: tuple, stage: str, error: str = "") -> None:
     if not _MODE:
         return
     key = "/".join(family) or "<leaf>"
-    rec = _DEGRADED.get(key)
-    if rec is None:
-        rec = _DEGRADED[key] = {"count": 0, "stages": {}, "last_error": ""}
-    rec["count"] += 1
-    rec["stages"][stage] = rec["stages"].get(stage, 0) + 1
-    if error:
-        rec["last_error"] = error
+    for st in _STATES:
+        rec = st.degraded.get(key)
+        if rec is None:
+            rec = st.degraded[key] = {"count": 0, "stages": {}, "last_error": ""}
+        rec["count"] += 1
+        rec["stages"][stage] = rec["stages"].get(stage, 0) + 1
+        if error:
+            rec["last_error"] = error
     if _MODE >= 2:
-        _EVENTS.append({"kind": "degraded", "family": key, "stage": stage, "error": error})
+        _emit("degraded", family=key, stage=stage, error=error)
 
 
 def degraded_counts() -> Dict[str, int]:
     """Per-op-family guarded-forcing degradation counts — the assertable
     surface (``collective_counts()``-style) the resilience suite pins."""
-    return {key: rec["count"] for key, rec in _DEGRADED.items()}
+    return {key: rec["count"] for key, rec in _cur().degraded.items()}
 
 
-def degraded() -> Dict[str, Dict[str, Any]]:
-    """Full degradation accounting: count, per-stage breakdown, last error."""
+def _render_degraded(st: _State) -> Dict[str, Dict[str, Any]]:
     return {
         key: {
             "count": rec["count"],
             "stages": dict(rec["stages"]),
             "last_error": rec["last_error"],
         }
-        for key, rec in _DEGRADED.items()
+        for key, rec in st.degraded.items()
     }
+
+
+def degraded() -> Dict[str, Dict[str, Any]]:
+    """Full degradation accounting: count, per-stage breakdown, last error."""
+    return _render_degraded(_cur())
+
+
+def record_fault(site: str, pattern: str = "") -> None:
+    """Count one *injected* fault firing at ``site`` (``core/resilience.py``
+    harness) — faults are first-class timeline events, so a trace shows the
+    degradation/retry activity right next to the fault that caused it."""
+    if not _MODE:
+        return
+    for st in _STATES:
+        st.faults[site] = st.faults.get(site, 0) + 1
+    if _MODE >= 2:
+        _emit("fault", site=site, pattern=pattern)
+
+
+def fault_events() -> Dict[str, int]:
+    """Per-site injected-fault counts as telemetry saw them (the resilience
+    harness's own ``fault_counts()`` is the mode-independent ledger)."""
+    return dict(_cur().faults)
 
 
 def record_nonfinite(where: str) -> None:
     """Count one errstate non-finite detection at forcing point ``where``."""
     if not _MODE:
         return
-    _NONFINITE[where] = _NONFINITE.get(where, 0) + 1
+    for st in _STATES:
+        st.nonfinite[where] = st.nonfinite.get(where, 0) + 1
     if _MODE >= 2:
-        _EVENTS.append({"kind": "nonfinite", "where": where})
+        _emit("nonfinite", where=where)
 
 
 def nonfinite_counts() -> Dict[str, int]:
     """Per-forcing-point errstate non-finite detections."""
-    return dict(_NONFINITE)
+    return dict(_cur().nonfinite)
 
 
 def record_io_retry(site: str) -> None:
     """Count one transient-``OSError`` retry at I/O injection site ``site``."""
     if not _MODE:
         return
-    _IO_RETRIES[site] = _IO_RETRIES.get(site, 0) + 1
+    for st in _STATES:
+        st.io_retries[site] = st.io_retries.get(site, 0) + 1
     if _MODE >= 2:
-        _EVENTS.append({"kind": "io_retry", "site": site})
+        _emit("io_retry", site=site)
 
 
 def io_retries() -> Dict[str, int]:
     """Per-site transient I/O retry counts."""
-    return dict(_IO_RETRIES)
+    return dict(_cur().io_retries)
 
 
 def record_checkpoint(event: str, step: Optional[int] = None, detail: str = "") -> None:
@@ -625,18 +1005,22 @@ def record_checkpoint(event: str, step: Optional[int] = None, detail: str = "") 
     ``save`` (manifest committed), ``restore`` (verified restore completed),
     ``corrupt`` (a checkpoint failed verification), ``fallback`` (restore
     skipped unverifiable newer checkpoints), ``gc`` (retention/debris sweep
-    removed something). The assertable surface the checkpoint suite pins."""
+    removed something). The assertable surface the checkpoint suite pins;
+    finer-grained phase boundaries ride :func:`record_event`
+    (``checkpoint_phase``) so they land on the timeline without disturbing
+    these counts."""
     if not _MODE:
         return
-    _CHECKPOINT[event] = _CHECKPOINT.get(event, 0) + 1
+    for st in _STATES:
+        st.checkpoint[event] = st.checkpoint.get(event, 0) + 1
     if _MODE >= 2:
-        _EVENTS.append({"kind": "checkpoint", "event": event, "step": step, "detail": detail})
+        _emit("checkpoint", event=event, step=step, detail=detail)
 
 
 def checkpoint_events() -> Dict[str, int]:
     """Per-event checkpoint lifecycle counts (``save``/``restore``/
     ``corrupt``/``fallback``/``gc``)."""
-    return dict(_CHECKPOINT)
+    return dict(_cur().checkpoint)
 
 
 # ----------------------------------------------------------------------
@@ -660,37 +1044,44 @@ def span(name: str):
     ``"fit/iter"``), attribute the collective / forcing / retrace deltas that
     occur inside them, absorb ``utils/profiling.Timer`` records closing
     within them, and mirror their own wall time into the Timer registry as
-    ``span:<path>`` so the two report surfaces stay joined. Yields the full
-    span path (or None when telemetry is off)."""
+    ``span:<path>`` so the two report surfaces stay joined. In verbose mode
+    each span emits ``span_begin``/``span_end`` timeline events — the B/E
+    duration pair of the exported trace. Yields the full span path (or None
+    when telemetry is off)."""
     if not _MODE:
         yield None
         return
     path = (_SPAN_STACK[-1].path + "/" + name) if _SPAN_STACK else name
     frame = _SpanFrame(path)
     _SPAN_STACK.append(frame)
+    if _MODE >= 2:
+        _emit("span_begin", name=path)
     try:
         yield path
     finally:
         _SPAN_STACK.pop()
         elapsed = time.perf_counter() - frame.t0
-        rec = _SPANS.get(path)
-        if rec is None:
-            rec = _SPANS[path] = {
-                "calls": 0,
-                "total_s": 0.0,
-                "collectives": {},
-                "forces": 0,
-                "retraces": 0,
-                "timers": {},
-            }
-        rec["calls"] += 1
-        rec["total_s"] += elapsed
-        rec["forces"] += frame.forces
-        rec["retraces"] += frame.retraces
-        for op, cnt in frame.collectives.items():
-            rec["collectives"][op] = rec["collectives"].get(op, 0) + cnt
-        for tname, secs in frame.timers.items():
-            rec["timers"][tname] = rec["timers"].get(tname, 0.0) + secs
+        if _MODE >= 2:
+            _emit("span_end", name=path, dur=elapsed)
+        for st in _STATES:
+            rec = st.spans.get(path)
+            if rec is None:
+                rec = st.spans[path] = {
+                    "calls": 0,
+                    "total_s": 0.0,
+                    "collectives": {},
+                    "forces": 0,
+                    "retraces": 0,
+                    "timers": {},
+                }
+            rec["calls"] += 1
+            rec["total_s"] += elapsed
+            rec["forces"] += frame.forces
+            rec["retraces"] += frame.retraces
+            for op, cnt in frame.collectives.items():
+                rec["collectives"][op] = rec["collectives"].get(op, 0) + cnt
+            for tname, secs in frame.timers.items():
+                rec["timers"][tname] = rec["timers"].get(tname, 0.0) + secs
         try:  # mirror into the Timer registry (utils/profiling nesting contract)
             from ..utils import profiling
 
@@ -700,18 +1091,20 @@ def span(name: str):
 
 
 def on_timer(name: str, elapsed: float) -> None:
-    """Called by ``utils/profiling.Timer`` on every record so timers closing
+    """Called by ``utils/profiling.Timer`` on every record: timers closing
     inside an active span are attributed to EVERY enclosing span — the same
-    roll-up rule as collectives/forces (``span:`` mirrors excluded)."""
-    if not _SPAN_STACK or name.startswith("span:"):
+    roll-up rule as collectives/forces (``span:`` mirrors excluded) — and in
+    verbose mode each close lands on the timeline as a ``timer`` event (the
+    exporter renders it as a B/E pair over its duration)."""
+    if name.startswith("span:"):
         return
+    if _MODE >= 2:
+        _emit("timer", name=name, dur=elapsed)
     for frame in _SPAN_STACK:
         frame.timers[name] = frame.timers.get(name, 0.0) + elapsed
 
 
-def spans() -> Dict[str, Dict[str, Any]]:
-    """Per-span aggregates: calls, wall seconds, attributed collective
-    counts, forces, retraces and nested timer seconds."""
+def _render_spans(st: _State) -> Dict[str, Dict[str, Any]]:
     return {
         path: {
             "calls": rec["calls"],
@@ -721,40 +1114,111 @@ def spans() -> Dict[str, Dict[str, Any]]:
             "retraces": rec["retraces"],
             "timers": dict(rec["timers"]),
         }
-        for path, rec in _SPANS.items()
+        for path, rec in st.spans.items()
     }
+
+
+def spans() -> Dict[str, Dict[str, Any]]:
+    """Per-span aggregates: calls, wall seconds, attributed collective
+    counts, forces, retraces and nested timer seconds."""
+    return _render_spans(_cur())
 
 
 # ----------------------------------------------------------------------
 # report
 # ----------------------------------------------------------------------
-def report() -> Dict[str, Any]:
+def _memory_block() -> Dict[str, Any]:
+    """Best-effort memory picture: per-device backend stats (TPU exposes
+    them; forced-host CPU returns {}) + live device-buffer bytes. Never
+    forces a chain, never raises — and never INITIALIZES anything: until the
+    mesh singleton exists the block stays empty, because report() (and the
+    background metrics sink) must not pin the JAX backend before the user
+    flips platforms (the lazy-singleton contract in heat_tpu/__init__.py)."""
+    out: Dict[str, Any] = {"device": {}, "live_buffers": {}}
+    try:
+        from . import communication
+
+        if communication.MESH_WORLD is None:
+            return out
+        from ..utils import health, profiling
+
+        out["device"] = profiling.device_memory_stats()
+        out["live_buffers"] = health.memory_report()
+    except Exception:  # pragma: no cover - backend-dependent
+        pass
+    return out
+
+
+def _programs_block(top: Optional[int] = None) -> Dict[str, Any]:
+    """Top-N cached sharded programs by dispatch count (cheap metadata only;
+    memoized cost estimates are merged in when :func:`program_costs` has
+    been asked to compute them — report() itself never compiles)."""
+    from . import fusion
+
+    progs = fusion.programs()
+    ranked = sorted(progs.items(), key=lambda kv: kv[1].get("dispatches", 0), reverse=True)
+    n = _TOP_PROGRAMS if top is None else top
+    return {
+        "cached": len(progs),
+        "top": [dict(rec, key=key) for key, rec in ranked[:n]],
+    }
+
+
+def program_costs(top: Optional[int] = None, refresh: bool = False) -> Dict[str, Dict[str, Any]]:
+    """Per-cached-program cost estimates keyed by program key: flops and
+    bytes-accessed from XLA's cost analysis of the program's HLO, in-program
+    collective counts (:func:`hlo_collective_counts`), and the logical
+    operand/result bytes from the recorded signature. Estimates are computed
+    by AOT-lowering the cached signature from its abstract leaf specs — an
+    extra compile per program, so results are memoized (``refresh=True``
+    recomputes) and ``report()`` only merges already-computed ones. Never
+    touches live data or forces a chain."""
+    from . import fusion
+
+    return fusion.program_costs(top=top, refresh=refresh)
+
+
+def report(*, _state: Optional[_State] = None) -> Dict[str, Any]:
     """The whole telemetry picture as one structured dict (JSON-ready via
-    :func:`report_json`). Includes the fusion program-cache counters and the
-    ``utils/profiling`` timer registry so one call answers "where did the
-    time, the bytes and the compiles go"."""
+    :func:`report_json`). Includes the fusion program-cache counters, the
+    ``utils/profiling`` timer registry, the ``memory`` block and every
+    completed :func:`scope` — one call answers "where did the time, the
+    bytes, the compiles and the memory go". Inside a scope, the counter
+    blocks are the scope's own isolated view (``_state`` is the internal
+    override the background metrics sink uses to always stream the GLOBAL
+    view, whatever scope the main thread happens to be inside)."""
+    st = _state if _state is not None else _cur()
     doc: Dict[str, Any] = {
         "enabled": active(),
         "mode": {0: "off", 1: "on", 2: "verbose"}[_MODE],
-        "collectives": collectives(),
-        "collective_counts": collective_counts(),
-        "fused_collectives": fused_collectives(),
-        "async_forcing": async_forcing(),
-        "forcing_points": forcing_points(),
-        "dispatches": dispatches(),
-        "unfused_reasons": unfused_reasons(),
-        "retraces": retraces(),
-        "degraded": degraded(),
-        "nonfinite": nonfinite_counts(),
-        "io_retries": io_retries(),
-        "checkpoint": checkpoint_events(),
-        "jit_compiles": dict(_COMPILES),
-        "spans": spans(),
+        "collectives": _render_collectives(st),
+        "collective_counts": {op: rec["count"] for op, rec in st.collectives.items()},
+        "fused_collectives": dict(st.fused_collectives),
+        "async_forcing": _render_async(st),
+        "forcing_points": _render_forces(st),
+        "dispatches": {k: dict(v) for k, v in st.dispatches.items()},
+        "unfused_reasons": {k: dict(v) for k, v in st.unfused.items()},
+        "retraces": _render_retraces(st),
+        "degraded": _render_degraded(st),
+        "nonfinite": dict(st.nonfinite),
+        "io_retries": dict(st.io_retries),
+        "checkpoint": dict(st.checkpoint),
+        "faults": dict(st.faults),
+        "jit_compiles": dict(st.compiles),
+        "spans": _render_spans(st),
+        "timeline": {
+            "events": len(st.events),
+            "events_dropped": st.events_dropped,
+            "cap": _EVENT_CAP,
+        },
+        "scopes": scope_reports(),
+        "memory": _memory_block(),
     }
     try:
         from . import fusion
 
         doc["fusion_cache"] = fusion.cache_stats()
+        doc["programs"] = _programs_block()
     except Exception:  # pragma: no cover
         pass
     try:
@@ -764,13 +1228,45 @@ def report() -> Dict[str, Any]:
     except Exception:  # pragma: no cover
         pass
     if _MODE >= 2:
-        doc["events"] = list(_EVENTS)
+        doc["events"] = list(st.events)
     return doc
 
 
+def _jsonable(obj):
+    """Deterministic JSON projection: tuple keys join with "/", sets sort,
+    tuples become lists, numpy scalars unbox — the schema-stability contract
+    (no ``default=str`` drift for structured content)."""
+    if isinstance(obj, dict):
+        out = {}
+        for k, v in obj.items():
+            if isinstance(k, tuple):
+                k = "/".join(str(p) for p in k)
+            elif not isinstance(k, str):
+                k = str(k)
+            out[k] = _jsonable(v)
+        return out
+    if isinstance(obj, (list, tuple, deque)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, (set, frozenset)):
+        return sorted(str(v) for v in obj)
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    item = getattr(obj, "item", None)  # numpy scalars
+    if callable(item):
+        try:
+            return item()
+        except Exception:  # pragma: no cover
+            pass
+    return str(obj)
+
+
 def report_json(path: Optional[str] = None, indent: int = 2) -> str:
-    """:func:`report` serialized to JSON; written to ``path`` when given."""
-    text = json.dumps(report(), indent=indent, default=str)
+    """:func:`report` serialized to JSON; written to ``path`` when given.
+    Serialization is deterministic (:func:`_jsonable`): every key is a
+    string, tuples/sets have a pinned projection, and ``default=str`` is
+    only a last-resort safety net — round-tripping through ``json.loads``
+    is schema-stable across calls."""
+    text = json.dumps(_jsonable(report()), indent=indent, default=str)
     if path is not None:
         with open(path, "w") as fh:
             fh.write(text)
@@ -778,9 +1274,324 @@ def report_json(path: Optional[str] = None, indent: int = 2) -> str:
     return text
 
 
-def events() -> List[dict]:
-    """The capped verbose event log (empty unless ``HEAT_TPU_TELEMETRY=verbose``)."""
-    return list(_EVENTS)
+# ----------------------------------------------------------------------
+# Chrome/Perfetto trace export
+# ----------------------------------------------------------------------
+def _host_index() -> int:
+    try:
+        from . import multihost
+
+        return int(multihost.process_index())
+    except Exception:  # pragma: no cover - import-order safety
+        return 0
+
+
+def _us(ts: float) -> float:
+    return round(ts * 1e6, 3)
+
+
+#: instant-event rendering: kind -> (category, name builder)
+_INSTANT_KINDS = {
+    "collective": ("collective", lambda ev: ev.get("op", "collective")),
+    "fused_collective": ("collective", lambda ev: "fused:" + str(ev.get("op"))),
+    "record": ("record", lambda ev: "record:" + str(ev.get("op"))),
+    "compile": ("compile", lambda ev: "compile:" + str(ev.get("label") or ev.get("family") or ev.get("program"))),
+    "force": ("force", lambda ev: "force:" + str(ev.get("trigger"))),
+    "degraded": ("degrade", lambda ev: "degraded:" + str(ev.get("family"))),
+    "fault": ("fault", lambda ev: "fault:" + str(ev.get("site"))),
+    "io_retry": ("io", lambda ev: "io_retry:" + str(ev.get("site"))),
+    "io": ("io", lambda ev: "io:" + str(ev.get("op", "op"))),
+    "checkpoint": ("checkpoint", lambda ev: "checkpoint:" + str(ev.get("event"))),
+    "checkpoint_phase": ("checkpoint", lambda ev: "ckpt:" + str(ev.get("phase"))),
+    "nonfinite": ("errstate", lambda ev: "nonfinite:" + str(ev.get("where"))),
+}
+
+
+def async_pairs(evs: Optional[List[dict]] = None) -> List[tuple]:
+    """Match the timeline's ``dispatch`` events to the ``blocking_sync``
+    events that waited on them via correlation id: a sync waits on the
+    dispatch whose root set (``cids``) contains its chain's ``cid``.
+    Returns ``[(dispatch_event, sync_event), ...]`` — the exporter's async
+    pair source and the assertable surface for "this sync waited on that
+    program"."""
+    if evs is None:
+        evs = list(_cur().events)
+    by_cid: Dict[int, dict] = {}
+    for ev in evs:
+        if ev.get("kind") != "dispatch":
+            continue
+        for cid in ev.get("cids") or ([ev["cid"]] if ev.get("cid") is not None else []):
+            by_cid[cid] = ev
+    pairs = []
+    for ev in evs:
+        if ev.get("kind") != "blocking_sync" or ev.get("cid") is None:
+            continue
+        disp = by_cid.get(ev["cid"])
+        if disp is not None:
+            pairs.append((disp, ev))
+    return pairs
+
+
+def trace_events(evs: Optional[List[dict]] = None, pid: Optional[int] = None) -> List[dict]:
+    """Render the timeline as a list of Chrome trace-event dicts: spans and
+    timers as B/E duration pairs, dispatch→blocking-sync as async ``b``/``e``
+    pairs keyed by cid, everything else as thread-scoped instants. One
+    process row per host (``pid`` defaults to ``multihost.process_index()``),
+    everything on tid 0."""
+    if evs is None:
+        evs = list(_cur().events)
+    if pid is None:
+        pid = _host_index()
+    tid = 0
+    out: List[dict] = [
+        {"ph": "M", "name": "process_name", "pid": pid, "tid": tid,
+         "args": {"name": f"heat_tpu host {pid}"}},
+        {"ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+         "args": {"name": "python"}},
+    ]
+
+    def args_of(ev, *skip):
+        return {
+            k: _jsonable(v)
+            for k, v in ev.items()
+            if k not in ("kind", "ts") and k not in skip and v is not None
+        }
+
+    for ev in sorted(evs, key=lambda e: e.get("ts", 0.0)):
+        kind = ev.get("kind")
+        ts = _us(ev.get("ts", 0.0))
+        if kind == "span_begin":
+            out.append({"ph": "B", "cat": "span", "name": ev.get("name"),
+                        "pid": pid, "tid": tid, "ts": ts, "args": args_of(ev, "name")})
+        elif kind == "span_end":
+            out.append({"ph": "E", "cat": "span", "name": ev.get("name"),
+                        "pid": pid, "tid": tid, "ts": ts})
+        elif kind == "timer":
+            dur = float(ev.get("dur", 0.0))
+            start = _us(ev["ts"] - dur)
+            name = str(ev.get("name"))
+            out.append({"ph": "B", "cat": "timer", "name": name,
+                        "pid": pid, "tid": tid, "ts": start})
+            out.append({"ph": "E", "cat": "timer", "name": name,
+                        "pid": pid, "tid": tid, "ts": ts})
+        elif kind == "blocking_sync":
+            name = "sync:" + str(ev.get("where"))
+            if "dur" in ev:
+                out.append({"ph": "X", "cat": "sync", "name": name,
+                            "pid": pid, "tid": tid, "ts": ts,
+                            "dur": _us(float(ev["dur"])), "args": args_of(ev, "dur")})
+            else:
+                out.append({"ph": "i", "s": "t", "cat": "sync", "name": name,
+                            "pid": pid, "tid": tid, "ts": ts, "args": args_of(ev)})
+        elif kind == "dispatch":
+            out.append({"ph": "i", "s": "t", "cat": "dispatch", "name": "dispatch",
+                        "pid": pid, "tid": tid, "ts": ts, "args": args_of(ev)})
+        else:
+            cat, name_of = _INSTANT_KINDS.get(kind, ("event", lambda e, k=kind: str(k)))
+            out.append({"ph": "i", "s": "t", "cat": cat, "name": name_of(ev),
+                        "pid": pid, "tid": tid, "ts": ts, "args": args_of(ev)})
+
+    # dispatch -> blocking-sync async pairs, keyed by correlation id. The
+    # sync event is stamped when the host boundary NOTES the pending chain
+    # (just before it triggers the dispatch), so the pair opens at the
+    # earlier of the two stamps and closes when the host holds the value.
+    for disp, sync in async_pairs(evs):
+        start = min(disp["ts"], sync["ts"])
+        end = max(disp["ts"], sync["ts"] + float(sync.get("dur", 0.0)))
+        ident = str(sync.get("cid"))
+        name = "dispatch→sync"
+        common = {"cat": "async_forcing", "name": name, "id": ident, "pid": pid, "tid": tid}
+        out.append(dict(common, ph="b", ts=_us(start),
+                        args={"program": disp.get("program"), "roots": disp.get("roots"),
+                              "where": sync.get("where"), "cid": sync.get("cid")}))
+        out.append(dict(common, ph="e", ts=_us(end)))
+    return out
+
+
+def export_trace(path: Optional[str] = None, events: Optional[List[dict]] = None) -> Dict[str, Any]:
+    """Export the trace timeline as Chrome/Perfetto trace-event JSON
+    (`chrome://tracing` / ui.perfetto.dev). Inside a :func:`scope` this
+    exports the scope's own timeline. Returns the trace document; written to
+    ``path`` when given. Requires ``HEAT_TPU_TELEMETRY=verbose`` to have
+    been active while the events of interest were recorded (the timeline is
+    empty otherwise — the export itself works in any mode and never forces a
+    pending chain)."""
+    doc = {
+        "traceEvents": trace_events(events),
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "tool": "heat_tpu.telemetry",
+            "host": _host_index(),
+            "mode": {0: "off", 1: "on", 2: "verbose"}[_MODE],
+        },
+    }
+    if path is not None:
+        with open(path, "w") as fh:
+            json.dump(doc, fh)
+            fh.write("\n")
+    return doc
+
+
+def merge_traces(paths: List[str], path: Optional[str] = None, align: bool = True) -> Dict[str, Any]:
+    """Stitch per-host trace files (one :func:`export_trace` output per
+    controller) into a single multi-process trace: each input keeps its own
+    process row (re-pid'd by input order on collision), and ``align=True``
+    shifts every input so its earliest timestamp sits at zero — perf_counter
+    epochs differ across hosts, so only relative time is meaningful."""
+    merged: List[dict] = []
+    seen_pids: set = set()
+    for i, p in enumerate(paths):
+        with open(p) as fh:
+            doc = json.load(fh)
+        evs = doc.get("traceEvents", [])
+        pids = {ev.get("pid", 0) for ev in evs}
+        remap = {}
+        for old in sorted(pids):
+            new = old
+            while new in seen_pids:
+                new = max(seen_pids) + 1
+            seen_pids.add(new)
+            remap[old] = new
+        stamps = [ev["ts"] for ev in evs if "ts" in ev]
+        base = min(stamps) if (align and stamps) else 0.0
+        for ev in evs:
+            ev = dict(ev)
+            ev["pid"] = remap.get(ev.get("pid", 0), ev.get("pid", 0))
+            if "ts" in ev:
+                ev["ts"] = round(ev["ts"] - base, 3)
+            merged.append(ev)
+    doc = {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "otherData": {"tool": "heat_tpu.telemetry", "merged_from": len(paths)},
+    }
+    if path is not None:
+        with open(path, "w") as fh:
+            json.dump(doc, fh)
+            fh.write("\n")
+    return doc
+
+
+def validate_trace(doc_or_path) -> List[str]:
+    """Structural problems of a Chrome trace-event document (or file path):
+    empty list = loads and every event carries the required keys. The CLI's
+    ``validate-trace`` and the CI matrix leg assert on this."""
+    problems: List[str] = []
+    doc = doc_or_path
+    if isinstance(doc_or_path, str):
+        try:
+            with open(doc_or_path) as fh:
+                doc = json.load(fh)
+        except Exception as exc:  # noqa: BLE001 - the problem IS the result
+            return [f"not valid JSON: {exc!r}"]
+    if not isinstance(doc, dict) or not isinstance(doc.get("traceEvents"), list):
+        return ["missing traceEvents list"]
+    open_async: Dict[str, int] = {}
+    for i, ev in enumerate(doc["traceEvents"]):
+        if not isinstance(ev, dict):
+            problems.append(f"event {i} is not an object")
+            continue
+        ph = ev.get("ph")
+        if ph is None or "pid" not in ev:
+            problems.append(f"event {i} missing ph/pid: {ev}")
+            continue
+        if ph != "M" and "ts" not in ev:
+            problems.append(f"event {i} ({ph}) missing ts")
+        if ph in ("b", "e") and "id" not in ev:
+            problems.append(f"async event {i} missing id")
+        if ph == "b":
+            open_async[str(ev.get("id"))] = open_async.get(str(ev.get("id")), 0) + 1
+        elif ph == "e":
+            key = str(ev.get("id"))
+            if open_async.get(key, 0) <= 0:
+                problems.append(f"async end without begin (id {key})")
+            else:
+                open_async[key] -= 1
+    for key, n in open_async.items():
+        if n:
+            problems.append(f"async begin without end (id {key})")
+    return problems
+
+
+# ----------------------------------------------------------------------
+# streaming metrics sink: HEAT_TPU_METRICS=<path>
+# ----------------------------------------------------------------------
+class _MetricsSink:
+    """Appends ``report()`` as one JSON line per flush to a file — the
+    zero-code-change observability tap for long jobs (``tail -f`` / a
+    sidecar scraper). A daemon thread flushes every ``interval`` seconds
+    (0 = at-exit only); the atexit hook writes the final line. Flushes never
+    raise and never force a pending chain."""
+
+    def __init__(self, path: str, interval: float):
+        self.path = path
+        self.interval = float(interval)
+        self.lines = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self.interval > 0 and self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="heat-tpu-metrics", daemon=True
+            )
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.flush("periodic")
+
+    def flush(self, event: str = "flush") -> bool:
+        try:
+            # always the GLOBAL state: the daemon thread's flush must not
+            # snapshot whatever request scope the main thread is inside
+            doc = report(_state=_GLOBAL)
+            doc.pop("events", None)  # the timeline has its own exporter
+            line = json.dumps(
+                _jsonable({"ts": time.time(), "event": event, "report": doc}),
+                default=str,
+            )
+            with open(self.path, "a") as fh:
+                fh.write(line + "\n")
+            self.lines += 1
+            return True
+        except Exception:  # noqa: BLE001 - observability must never take the job down
+            return False
+
+    def stop(self, final: bool = True) -> None:
+        self._stop.set()
+        if final:
+            self.flush("exit")
+
+
+_SINK: Optional[_MetricsSink] = None
+
+
+def set_metrics_sink(path: Optional[str], interval: Optional[float] = None) -> Optional[_MetricsSink]:
+    """(Re)configure the JSON-lines metrics sink in-process: ``path=None``
+    stops it (no final line), otherwise every ``interval`` seconds (default
+    ``HEAT_TPU_METRICS_INTERVAL``, 30s; 0 = at-exit only) and at interpreter
+    exit one ``report()`` line is appended to ``path``. Returns the sink."""
+    global _SINK
+    if _SINK is not None:
+        _SINK.stop(final=False)
+        _SINK = None
+    if path:
+        if interval is None:
+            interval = float(os.environ.get("HEAT_TPU_METRICS_INTERVAL", "30"))
+        _SINK = _MetricsSink(path, interval)
+        _SINK.start()
+    return _SINK
+
+
+def _sink_atexit() -> None:
+    if _SINK is not None:
+        _SINK.stop(final=True)
+
+
+atexit.register(_sink_atexit)
+if os.environ.get("HEAT_TPU_METRICS"):
+    set_metrics_sink(os.environ["HEAT_TPU_METRICS"])
 
 
 # ----------------------------------------------------------------------

@@ -124,6 +124,14 @@ _PAYLOAD_RE = re.compile(r"^ckpt_(\d+)(\.r\d+)?$")
 _T_IO = telemetry.force_trigger("io")
 
 
+def _phase(phase: str, step=None, **fields) -> None:
+    """One ``checkpoint_phase`` trace-timeline event (verbose mode only) —
+    phase boundaries the exported trace shows WITHOUT disturbing the
+    ``telemetry.checkpoint_events()`` lifecycle counts the suites pin."""
+    if telemetry._MODE >= 2:
+        telemetry.record_event("checkpoint_phase", phase=phase, step=step, **fields)
+
+
 class CheckpointCorruptError(RuntimeError):
     """A checkpoint failed verification (torn payload, checksum mismatch,
     truncated legacy msgpack) and the configured policy forbids — or could
@@ -492,6 +500,7 @@ def save_checkpoint(directory: str, tree: Any, step: int = 0, keep: int = 3) -> 
 
     paths, leaves, _ = _flatten_with_paths(tree)
     owner = multihost.io_owner()
+    _phase("save_begin", step, leaves=len(leaves))
     # phase 1 — MATERIALIZE: everything that may launch a collective
     # (forcing a pending fused chain, allgathering a non-addressable array)
     # runs here, synchronously on every controller, BEFORE any deferred-error
@@ -513,6 +522,7 @@ def save_checkpoint(directory: str, tree: Any, step: int = 0, keep: int = 3) -> 
         else:
             _encode_py(leaf)  # unserializable-leaf errors raise symmetrically
 
+    _phase("save_materialized", step)
     # phase 2 — WRITE (local file I/O only). A local failure here must NOT
     # skip the barriers below: the other controllers are (or will be) parked
     # in sync_processes with no timeout, and an early raise would hang the
@@ -575,6 +585,7 @@ def save_checkpoint(directory: str, tree: Any, step: int = 0, keep: int = 3) -> 
         except BaseException as exc:  # noqa: BLE001 - re-raised after the barriers
             err = exc
 
+    _phase("save_staged", step, leaves=len(entries))
     # every host's shard files (and receipts) must be on the (shared)
     # filesystem before the owner builds the manifest it is about to publish
     multihost.sync_processes(f"heat_tpu.checkpoint.save.{step}")
@@ -627,6 +638,7 @@ def save_checkpoint(directory: str, tree: Any, step: int = 0, keep: int = 3) -> 
 
             resilience.call_with_retries("checkpoint.commit", _commit)
             telemetry.record_checkpoint("save", step)
+            _phase("save_committed", step)
         except BaseException as exc:  # noqa: BLE001 - re-raised after the barrier
             err = exc
     # non-owners wait for the commit so no controller returns (and possibly
@@ -883,6 +895,7 @@ def _restore_manifest(directory: str, step: int, target: Any) -> Any:
                 f"checkpoint step {step} in {directory!r}: unknown leaf kind {kind!r}"
             )
     telemetry.record_checkpoint("restore", step)
+    _phase("restore_done", step, leaves=len(out))
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
@@ -924,6 +937,7 @@ def _restore_legacy(directory: str, step: int, target: Any) -> Any:
 
 
 def _restore_step(directory: str, step: int, target: Any) -> Any:
+    _phase("restore_begin", step)
     if os.path.exists(_manifest_path(directory, step)):
         return _restore_manifest(directory, step, target)
     return _restore_legacy(directory, step, target)

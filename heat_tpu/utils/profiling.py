@@ -27,6 +27,10 @@ from typing import Any, Callable, Dict, Optional
 
 import jax
 
+# module-level, not per-call: record_timing sits on the Timer hot path and
+# core.telemetry has no module-level dependency back on utils (no cycle)
+from heat_tpu.core import telemetry as _telemetry
+
 __all__ = [
     "Timer",
     "annotate",
@@ -84,17 +88,17 @@ class Timer:
 def record_timing(name: str, elapsed: float) -> None:
     """Record one completed timing into the registry (the shared path for
     ``Timer`` and ``heat_tpu.telemetry.span``). Active telemetry spans absorb
-    timers closing inside them (``ht.telemetry.span`` nesting contract)."""
+    timers closing inside them (``ht.telemetry.span`` nesting contract), and
+    in verbose mode every close lands on the trace timeline as a ``timer``
+    event the exporter renders as a B/E duration pair."""
     rec = Timer._registry.setdefault(
         name, {"calls": 0, "total_s": 0.0, "best_s": float("inf")}
     )
     rec["calls"] += 1
     rec["total_s"] += elapsed
     rec["best_s"] = min(rec["best_s"], elapsed)
-    from heat_tpu.core import telemetry
-
-    if telemetry._MODE:
-        telemetry.on_timer(name, elapsed)
+    if _telemetry._MODE:
+        _telemetry.on_timer(name, elapsed)
 
 
 @functools.lru_cache(maxsize=None)

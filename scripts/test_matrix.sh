@@ -38,6 +38,23 @@ HEAT_TPU_FUSION_COLLECTIVES=0 \
 echo "=== telemetry on (HEAT_TPU_TELEMETRY=1) ==="
 HEAT_TPU_TELEMETRY=1 \
   python -m pytest tests/test_telemetry.py tests/test_eager_chain.py tests/test_linalg_depth.py -q -x
+# trace-timeline leg: the full verbose event log (timestamps, correlation
+# ids, scoped sessions) stays green on the telemetry + trace suites, and an
+# exported trace of a real reduction-chain run must parse as Chrome
+# trace-event JSON (the CLI's validate-trace is the same check CI users run)
+echo "=== telemetry verbose (HEAT_TPU_TELEMETRY=verbose) ==="
+HEAT_TPU_TELEMETRY=verbose \
+  python -m pytest tests/test_trace_timeline.py tests/test_telemetry.py -q -x
+HEAT_TPU_TELEMETRY=verbose python - <<'PY'
+import numpy as np, heat_tpu as ht
+from heat_tpu.core import telemetry
+a = ht.array(np.random.default_rng(0).standard_normal(
+    (8 * ht.get_comm().size, 3)).astype(np.float32), split=0)
+float(ht.mean(a)) + float(ht.std(a))  # dispatch + blocking sync on the timeline
+telemetry.export_trace("/tmp/heat_tpu_matrix_trace.json")
+PY
+HEAT_TPU_TELEMETRY=verbose \
+  python -m heat_tpu.telemetry validate-trace /tmp/heat_tpu_matrix_trace.json
 # resilience leg: the suite runs under the deterministic ambient fault mix
 # (core/resilience.py 'ci' preset: fused compiles/executes fail periodically
 # and degrade to eager, transient io errors are retried, checkpoint
@@ -50,7 +67,7 @@ echo "=== faults injected (HEAT_TPU_FAULTS=ci) ==="
 HEAT_TPU_FAULTS=ci HEAT_TPU_TELEMETRY=1 \
   python -m pytest tests/test_resilience.py tests/test_resilience_io.py tests/test_io_errors.py \
     tests/test_checkpoint_resilience.py tests/test_checkpoint_profiling.py \
-    tests/test_fused_collectives.py -q -x
+    tests/test_fused_collectives.py tests/test_trace_timeline.py -q -x
 # the coverage gate (reference codecov.yml target semantics): the merged
 # matrix coverage must clear the floor or the matrix run fails. On runtimes
 # without sys.monitoring (Python < 3.12) no cov_mesh*.json legs are produced

@@ -1,0 +1,598 @@
+"""Trace timeline, scoped telemetry sessions, Perfetto export and
+per-program cost accounting (ISSUE 6).
+
+Pins the acceptance criteria: a reduction-chain run exports a trace that
+loads as valid Chrome trace-event JSON with at least one dispatch→
+blocking-sync async pair whose correlation id links back to a
+``fusion.cache_stats()`` program key; ``telemetry.scope()`` counters are
+isolated from and roll up into the global report; injected faults appear as
+trace events; ``report_json`` is schema-stable (string keys everywhere, no
+``default=str`` drift for tuple-keyed families); event-log truncation is
+visible as ``events_dropped``; and ``telemetry.reset()`` also resets the
+``utils/profiling`` timer registry. Runs green at mesh 1/3/5/8 (matrix
+legs), with fusion off, and under ``HEAT_TPU_FAULTS=ci`` (tests that pin
+exact counts shield themselves with ``resilience.suspended()``).
+"""
+
+import io
+import json
+import os
+import tempfile
+import time
+import unittest
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.core import fusion, resilience, telemetry
+from heat_tpu.utils import profiling
+
+from harness import TestCase
+
+
+class TimelineCase(TestCase):
+    """verbose mode + clean caches, exact under the ambient CI fault mix."""
+
+    def setUp(self):
+        self._suspend = resilience.suspended()
+        self._suspend.__enter__()
+        self._prev_mode = telemetry.set_mode("verbose")
+        fusion.clear_cache()
+        telemetry.reset()
+
+    def tearDown(self):
+        telemetry.set_mode(self._prev_mode)
+        telemetry.reset()
+        self._suspend.__exit__(None, None, None)
+
+    def _split_input(self, seed=0, n_mult=4):
+        n = n_mult * self.get_size()
+        return ht.array(
+            np.random.default_rng(seed).standard_normal((n, 3)).astype(np.float32),
+            split=0,
+        )
+
+    def _reduction_chain(self, seed=0):
+        """The kmeans-shaped bench chain: mean -> var -> std, all read."""
+        a = self._split_input(seed)
+        m, v, s = ht.mean(a), ht.var(a), ht.std(a)
+        return float(m) + float(v) + float(s)
+
+
+@unittest.skipUnless(fusion.active(), "fusion disabled via HEAT_TPU_FUSION")
+class TestCorrelationIds(TimelineCase):
+    def test_chain_records_share_one_cid(self):
+        a = self._split_input()
+        x = ht.exp(a * 0.5) + 1.0
+        self.assertTrue(fusion.is_deferred(x))
+        cid = x._payload.cid
+        self.assertGreater(cid, 0)
+        # every op recorded ONTO the pending chain inherits its cid (leaf
+        # subtrees recorded before joining — e.g. the scalar cast — may carry
+        # their own until absorbed)
+        chain_ops = {
+            e["op"]: e["cid"]
+            for e in telemetry.events()
+            if e["kind"] == "record" and e["op"] in ("multiply", "exp", "add")
+        }
+        self.assertEqual(set(chain_ops), {"multiply", "exp", "add"})
+        self.assertEqual(set(chain_ops.values()), {cid}, chain_ops)
+
+    def test_dispatch_sync_pair_matches_program_key(self):
+        # the ISSUE acceptance pin: the reduction-chain run yields at least
+        # one dispatch -> blocking-sync async pair, correlated by cid, whose
+        # program key is a fusion.cache_stats() program key
+        self._reduction_chain()
+        evs = telemetry.events()
+        syncs = [e for e in evs if e["kind"] == "blocking_sync" and e.get("cid")]
+        self.assertGreaterEqual(len(syncs), 1, evs)
+        pairs = telemetry.async_pairs()
+        self.assertGreaterEqual(len(pairs), 1, evs)
+        keys = fusion.cache_stats()["program_keys"]
+        matched = [
+            (disp, sync)
+            for disp, sync in pairs
+            if disp.get("program") in keys and sync["cid"] in disp["cids"]
+        ]
+        self.assertGreaterEqual(len(matched), 1, (pairs, keys))
+
+    def test_blocking_sync_duration_is_stamped(self):
+        a = self._split_input(seed=3)
+        x = ht.exp(a * 0.25)
+        x.numpy()  # the host boundary closes its own sync event
+        syncs = [e for e in telemetry.events() if e["kind"] == "blocking_sync"]
+        self.assertEqual(len(syncs), 1, syncs)
+        self.assertIn("dur", syncs[0])
+        self.assertGreater(syncs[0]["dur"], 0.0)
+        self.assertEqual(syncs[0]["where"], "numpy")
+
+    def test_materialized_reads_leave_no_sync_event(self):
+        a = self._split_input(seed=4)
+        x = ht.exp(a * 0.5)
+        x.numpy()
+        telemetry.reset()
+        x.numpy()  # already materialized: free
+        self.assertEqual(
+            [e for e in telemetry.events() if e["kind"] == "blocking_sync"], []
+        )
+
+    def test_events_are_monotonically_timestamped(self):
+        self._reduction_chain(seed=5)
+        stamps = [e["ts"] for e in telemetry.events()]
+        self.assertEqual(stamps, sorted(stamps))
+        self.assertTrue(all(isinstance(t, float) for t in stamps))
+
+
+class TestScopedSessions(TimelineCase):
+    """scope(): isolation through the query functions, live rollup into the
+    global report, archival under report()["scopes"]."""
+
+    def test_isolation_and_rollup(self):
+        telemetry.record_collective("allreduce", "split", 1024, "float32")
+        with telemetry.scope("sess") as path:
+            self.assertEqual(path, "sess")
+            # isolated: the outer collective is NOT visible inside
+            self.assertEqual(telemetry.collective_counts(), {})
+            telemetry.record_collective("allgather", "split", 64, "float32")
+            self.assertEqual(telemetry.collective_counts(), {"allgather": 1})
+        # rolled up: after exit the global state holds both
+        self.assertEqual(
+            telemetry.collective_counts(), {"allreduce": 1, "allgather": 1}
+        )
+        arch = telemetry.report()["scopes"]["sess"]
+        self.assertEqual(arch["collective_counts"], {"allgather": 1})
+        self.assertEqual(arch["calls"], 1)
+        self.assertGreater(arch["wall_s"], 0.0)
+
+    def test_nested_scope_paths_and_rollup(self):
+        with telemetry.scope("outer"):
+            telemetry.record_collective("bcast", None, 8, "int32")
+            with telemetry.scope("inner") as inner_path:
+                self.assertEqual(inner_path, "outer/inner")
+                telemetry.record_collective("allreduce", None, 8, "int32")
+                # innermost isolation: outer's bcast is invisible here
+                self.assertEqual(telemetry.collective_counts(), {"allreduce": 1})
+            # inner rolled into outer live
+            self.assertEqual(
+                telemetry.collective_counts(), {"bcast": 1, "allreduce": 1}
+            )
+        scopes = telemetry.scope_reports()
+        self.assertEqual(scopes["outer/inner"]["collective_counts"], {"allreduce": 1})
+        self.assertEqual(
+            scopes["outer"]["collective_counts"], {"bcast": 1, "allreduce": 1}
+        )
+
+    def test_reentry_accumulates(self):
+        for i in range(3):
+            with telemetry.scope("job"):
+                telemetry.record_collective("allreduce", None, 4, "float32")
+        arch = telemetry.scope_reports()["job"]
+        self.assertEqual(arch["calls"], 3)
+        self.assertEqual(arch["collective_counts"], {"allreduce": 3})
+
+    def test_scope_events_tagged_and_archived(self):
+        with telemetry.scope("tagged"):
+            telemetry.record_event("io", op="probe")
+        evs = [e for e in telemetry.events() if e["kind"] == "io"]
+        self.assertEqual(evs[0]["scope"], "tagged")
+        self.assertEqual(telemetry.scope_reports()["tagged"]["timeline"]["events"], 1)
+
+    @unittest.skipUnless(fusion.active(), "fusion disabled via HEAT_TPU_FUSION")
+    def test_scope_isolates_async_forcing(self):
+        self._reduction_chain(seed=11)  # global activity before the session
+        before = telemetry.async_forcing()["dispatches"]
+        self.assertGreaterEqual(before, 1)
+        with telemetry.scope("client"):
+            self.assertEqual(telemetry.async_forcing()["dispatches"], 0)
+            self._reduction_chain(seed=12)
+            inside = telemetry.async_forcing()["dispatches"]
+            self.assertGreaterEqual(inside, 1)
+        self.assertEqual(telemetry.async_forcing()["dispatches"], before + inside)
+        arch = telemetry.report()["scopes"]["client"]
+        self.assertEqual(arch["async_forcing"]["dispatches"], inside)
+
+    def test_scope_retrace_keys_stay_bounded_after_warn(self):
+        # regression: once a family's global RetraceWarning fired, fresh
+        # scope states (and re-entered archived scopes) must STOP collecting
+        # shape keys — per-request scopes under churn would otherwise grow
+        # the archived key set without bound
+        import warnings as _warnings
+
+        fam = ("churny",)
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore", telemetry.RetraceWarning)
+            for i in range(telemetry._RETRACE_WARN_AFTER + 2):
+                telemetry.record_retrace(fam, ("shape", i))
+            self.assertTrue(telemetry.retraces()["churny"]["warned"])
+            for round_ in range(3):
+                with telemetry.scope("req"):
+                    for i in range(50):
+                        telemetry.record_retrace(fam, ("churn", round_, i))
+        arch = telemetry.scope_reports()["req"]["retraces"]["churny"]
+        self.assertEqual(arch["misses"], 150)
+        self.assertLessEqual(arch["distinct_shapes"], telemetry._RETRACE_WARN_AFTER)
+
+    def test_scope_off_mode_yields_none(self):
+        prev = telemetry.set_mode(0)
+        try:
+            with telemetry.scope("noop") as path:
+                self.assertIsNone(path)
+            self.assertEqual(telemetry.scope_reports(), {})
+        finally:
+            telemetry.set_mode(prev)
+
+
+class TestTraceExport(TimelineCase):
+    def _run_workload(self):
+        with telemetry.span("fit"):
+            with profiling.Timer("step", sync=False):
+                time.sleep(0.001)
+            telemetry.record_collective("allreduce", "split", 256, "float32")
+        if fusion.active():
+            self._reduction_chain(seed=21)
+
+    def test_export_is_valid_trace_event_json(self):
+        self._run_workload()
+        with tempfile.TemporaryDirectory() as tmp:
+            path = os.path.join(tmp, "trace.json")
+            doc = telemetry.export_trace(path)
+            with open(path) as fh:
+                loaded = json.load(fh)
+        self.assertEqual(telemetry.validate_trace(loaded), [])
+        self.assertEqual(telemetry.validate_trace(doc), [])
+        evs = loaded["traceEvents"]
+        self.assertGreater(len(evs), 0)
+        for ev in evs:
+            self.assertIn("ph", ev)
+            self.assertIn("pid", ev)
+        # span B/E pairs balance per name
+        begins = [e for e in evs if e["ph"] == "B" and e.get("cat") == "span"]
+        ends = [e for e in evs if e["ph"] == "E" and e.get("cat") == "span"]
+        self.assertEqual(len(begins), len(ends))
+        self.assertGreaterEqual(len(begins), 1)
+        # the Timer close renders as a B/E pair too
+        self.assertTrue(any(e.get("cat") == "timer" for e in evs))
+        # collectives land as instants
+        self.assertTrue(
+            any(e["ph"] == "i" and e.get("cat") == "collective" for e in evs)
+        )
+
+    @unittest.skipUnless(fusion.active(), "fusion disabled via HEAT_TPU_FUSION")
+    def test_async_pairs_exported_and_balanced(self):
+        self._reduction_chain(seed=22)
+        doc = telemetry.export_trace()
+        evs = doc["traceEvents"]
+        b = [e for e in evs if e["ph"] == "b"]
+        e_ = [e for e in evs if e["ph"] == "e"]
+        self.assertGreaterEqual(len(b), 1, evs)
+        self.assertEqual(len(b), len(e_))
+        self.assertEqual(telemetry.validate_trace(doc), [])  # b/e ids match
+        for ev in b:
+            self.assertEqual(ev["cat"], "async_forcing")
+            self.assertIn("id", ev)
+        # the pair's begin never follows its end
+        by_id = {ev["id"]: ev["ts"] for ev in b}
+        for ev in e_:
+            self.assertGreaterEqual(ev["ts"], by_id[ev["id"]])
+
+    def test_merge_traces_repids_and_aligns(self):
+        self._run_workload()
+        with tempfile.TemporaryDirectory() as tmp:
+            p1 = os.path.join(tmp, "host0.json")
+            p2 = os.path.join(tmp, "host1.json")
+            telemetry.export_trace(p1)
+            telemetry.export_trace(p2)  # stands in for a second host's file
+            merged_path = os.path.join(tmp, "merged.json")
+            merged = telemetry.merge_traces([p1, p2], merged_path)
+            with open(merged_path) as fh:
+                loaded = json.load(fh)
+        self.assertEqual(telemetry.validate_trace(loaded), [])
+        pids = {e["pid"] for e in merged["traceEvents"]}
+        self.assertEqual(len(pids), 2, pids)  # one process row per host
+        stamps = [e["ts"] for e in merged["traceEvents"] if "ts" in e]
+        self.assertGreaterEqual(min(stamps), 0.0)  # aligned to zero
+
+    def test_validate_trace_flags_junk(self):
+        self.assertTrue(telemetry.validate_trace({"nope": 1}))
+        with tempfile.TemporaryDirectory() as tmp:
+            bad = os.path.join(tmp, "bad.json")
+            with open(bad, "w") as fh:
+                fh.write("{not json")
+            problems = telemetry.validate_trace(bad)
+        self.assertTrue(problems and "JSON" in problems[0])
+        self.assertTrue(
+            telemetry.validate_trace({"traceEvents": [{"name": "x"}]})
+        )  # missing ph/pid
+
+
+@unittest.skipUnless(fusion.active(), "fusion disabled via HEAT_TPU_FUSION")
+class TestFaultsOnTimeline(TimelineCase):
+    def test_injected_fault_appears_as_trace_event(self):
+        a = self._split_input(seed=31)
+        x = ht.exp(a * 0.5) + 1.0
+        with resilience.inject("fusion.compile", times=1):
+            import warnings as _warnings
+
+            with _warnings.catch_warnings():
+                _warnings.simplefilter("ignore", resilience.DegradedDispatchWarning)
+                x.numpy()  # the compile fault degrades the force to eager
+        kinds = [e["kind"] for e in telemetry.events()]
+        self.assertIn("fault", kinds)
+        self.assertIn("degraded", kinds)
+        fault_ev = next(e for e in telemetry.events() if e["kind"] == "fault")
+        self.assertEqual(fault_ev["site"], "fusion.compile")
+        self.assertEqual(telemetry.fault_events(), {"fusion.compile": 1})
+        # and the exporter renders it in the fault category
+        doc = telemetry.export_trace()
+        self.assertTrue(
+            any(e.get("cat") == "fault" for e in doc["traceEvents"]), doc
+        )
+
+
+class TestEventsDropped(TimelineCase):
+    def test_truncation_is_visible(self):
+        prev_cap = telemetry._EVENT_CAP
+        telemetry._EVENT_CAP = 8
+        try:
+            telemetry.reset()  # states pick up the new cap
+            for i in range(20):
+                telemetry.record_event("io", op="tick", i=i)
+            tl = telemetry.report()["timeline"]
+            self.assertEqual(tl["events"], 8)
+            self.assertEqual(tl["events_dropped"], 12)
+            self.assertEqual(tl["cap"], 8)
+            # the NEWEST events survive (deque drops the oldest)
+            self.assertEqual(telemetry.events()[-1]["i"], 19)
+        finally:
+            telemetry._EVENT_CAP = prev_cap
+            telemetry.reset()
+
+
+class TestResetAndMemory(TimelineCase):
+    def test_reset_clears_profiling_timers(self):
+        with profiling.Timer("stale_bench", sync=False):
+            pass
+        self.assertIn("stale_bench", profiling.report())
+        telemetry.reset()
+        self.assertEqual(profiling.report(), {})
+
+    def test_report_memory_block(self):
+        a = self._split_input(seed=41)
+        a.parray  # some live device buffers
+        mem = telemetry.report()["memory"]
+        self.assertIn("device", mem)
+        self.assertIn("live_buffers", mem)
+        self.assertIsInstance(mem["device"], dict)  # {} on forced-host CPU
+        self.assertGreaterEqual(mem["live_buffers"].get("total_bytes", 0), a.parray.nbytes)
+
+
+class TestMetricsSink(TimelineCase):
+    def test_jsonl_sink_flushes_and_parses(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            path = os.path.join(tmp, "metrics.jsonl")
+            sink = telemetry.set_metrics_sink(path, interval=0)  # at-exit only
+            try:
+                telemetry.record_collective("allreduce", None, 128, "float32")
+                self.assertTrue(sink.flush("test"))
+                sink.stop(final=True)  # the atexit behavior: one final line
+                with open(path) as fh:
+                    lines = [json.loads(line) for line in fh if line.strip()]
+            finally:
+                telemetry.set_metrics_sink(None)
+        self.assertEqual(len(lines), 2)
+        self.assertEqual([d["event"] for d in lines], ["test", "exit"])
+        for doc in lines:
+            self.assertIn("report", doc)
+            self.assertEqual(
+                doc["report"]["collective_counts"], {"allreduce": 1}
+            )
+            self.assertNotIn("events", doc["report"])  # the timeline stays out
+
+    def test_sink_streams_the_global_view_inside_a_scope(self):
+        # regression: the daemon thread's flush must not snapshot whatever
+        # request scope the main thread happens to be inside
+        telemetry.record_collective("allreduce", None, 64, "float32")
+        with tempfile.TemporaryDirectory() as tmp:
+            path = os.path.join(tmp, "metrics.jsonl")
+            sink = telemetry.set_metrics_sink(path, interval=0)
+            try:
+                with telemetry.scope("req"):
+                    self.assertEqual(telemetry.collective_counts(), {})  # isolated
+                    self.assertTrue(sink.flush("mid-scope"))
+                with open(path) as fh:
+                    doc = json.loads(fh.readline())
+            finally:
+                telemetry.set_metrics_sink(None)
+        self.assertEqual(
+            doc["report"]["collective_counts"], {"allreduce": 1}
+        )  # the GLOBAL view, not the empty scope's
+
+    def test_periodic_thread_flushes(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            path = os.path.join(tmp, "metrics.jsonl")
+            sink = telemetry.set_metrics_sink(path, interval=0.05)
+            try:
+                deadline = time.time() + 5.0
+                while sink.lines < 2 and time.time() < deadline:
+                    time.sleep(0.02)
+            finally:
+                telemetry.set_metrics_sink(None)
+            self.assertGreaterEqual(sink.lines, 2)
+            with open(path) as fh:
+                for line in fh:
+                    self.assertEqual(json.loads(line)["event"], "periodic")
+
+
+class TestReportSchemaStability(TimelineCase):
+    def _assert_json_native(self, obj, path="report"):
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                self.assertIsInstance(k, str, f"{path}: non-string key {k!r}")
+                self._assert_json_native(v, f"{path}.{k}")
+        elif isinstance(obj, list):
+            for i, v in enumerate(obj):
+                self._assert_json_native(v, f"{path}[{i}]")
+        else:
+            self.assertIsInstance(
+                obj, (str, int, float, bool, type(None)), f"{path}: {type(obj)}"
+            )
+
+    def test_every_block_round_trips_with_string_keys(self):
+        # produce tuple-keyed internal state on purpose: a retrace family
+        # and (under fusion) a degraded family
+        if fusion.active():
+            a = self._split_input(seed=51)
+            x = ht.exp(a * 0.5) + 1.0
+            with resilience.inject("fusion.compile", times=1):
+                import warnings as _warnings
+
+                with _warnings.catch_warnings():
+                    _warnings.simplefilter("ignore", resilience.DegradedDispatchWarning)
+                    x.numpy()
+        telemetry.record_collective("allreduce", "split", 64, "float32")
+        with telemetry.scope("schema"):
+            telemetry.record_event("io", op="probe", detail=("a", "b"))
+        text = telemetry.report_json()
+        doc = json.loads(text)
+        self._assert_json_native(doc)
+        # tuple-keyed families surface as joined strings, not str(tuple) drift
+        for fam in list(doc["retraces"]) + list(doc["degraded"]):
+            self.assertNotIn("(", fam, fam)
+        # tuples inside events project to lists deterministically
+        probe = [e for e in doc.get("events", []) if e.get("kind") == "io"]
+        if probe:
+            self.assertEqual(probe[0]["detail"], ["a", "b"])
+        # a second serialization of the same state parses identically on the
+        # stable counter blocks (timers/memory/wall clocks legitimately move)
+        doc2 = json.loads(telemetry.report_json())
+        for block in ("collective_counts", "retraces", "degraded", "checkpoint",
+                      "faults", "unfused_reasons", "dispatches", "scopes"):
+            self.assertEqual(doc[block], doc2[block], block)
+
+    def test_report_json_writes_loadable_file(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            path = os.path.join(tmp, "rep.json")
+            text = telemetry.report_json(path)
+            with open(path) as fh:
+                self.assertEqual(json.load(fh), json.loads(text))
+
+
+@unittest.skipUnless(fusion.active(), "fusion disabled via HEAT_TPU_FUSION")
+class TestProgramCosts(TimelineCase):
+    def test_costs_estimate_flops_bytes_and_collectives(self):
+        self._reduction_chain(seed=61)
+        self._reduction_chain(seed=61)  # steady state: dispatches > compiles
+        costs = telemetry.program_costs()
+        self.assertGreaterEqual(len(costs), 1)
+        keys = fusion.cache_stats()["program_keys"]
+        for key, cost in costs.items():
+            self.assertIn(key, keys)
+            self.assertGreater(cost["operand_bytes"], 0)
+            self.assertGreaterEqual(cost["dispatches"], 1)
+            self.assertIn("collectives", cost)
+            self.assertIn("family", cost)
+        top = max(costs.values(), key=lambda c: c["dispatches"])
+        self.assertIsNotNone(top["result_bytes"])
+        # XLA's CPU cost analysis reports flops for the reduction chain;
+        # treat None as acceptable only when the backend withheld analysis
+        if top.get("flops") is not None:
+            self.assertGreater(top["flops"], 0)
+        if self.get_size() > 1:
+            # the split-axis psums live INSIDE some cached program's HLO
+            self.assertTrue(
+                any(c["collectives"].get("all-reduce") for c in costs.values()),
+                costs,
+            )
+
+    def test_costs_are_memoized(self):
+        self._reduction_chain(seed=62)
+        first = telemetry.program_costs()
+        again = telemetry.program_costs()
+        self.assertEqual(set(first), set(again))
+        for key in first:
+            self.assertEqual(
+                {k: v for k, v in first[key].items() if k != "dispatches"},
+                {k: v for k, v in again[key].items() if k != "dispatches"},
+            )
+
+    def test_report_programs_block_ranks_by_dispatches(self):
+        self._reduction_chain(seed=63)
+        self._reduction_chain(seed=63)
+        block = telemetry.report()["programs"]
+        self.assertGreaterEqual(block["cached"], 1)
+        tops = block["top"]
+        self.assertGreaterEqual(len(tops), 1)
+        self.assertEqual(
+            [t["dispatches"] for t in tops],
+            sorted((t["dispatches"] for t in tops), reverse=True),
+        )
+        for t in tops:
+            self.assertIn("key", t)
+            self.assertIn("family", t)
+
+
+class TestCLI(TimelineCase):
+    @property
+    def _cli_module(self):
+        # importlib, not `from heat_tpu import telemetry`: the package
+        # attribute resolves to core.telemetry (set by heat_tpu/__init__) —
+        # the -m entry point is the SUBMODULE heat_tpu/telemetry.py
+        import importlib
+
+        return importlib.import_module("heat_tpu.telemetry")
+
+    def _cli(self, *argv):
+        out = io.StringIO()
+        rc = self._cli_module.main(list(argv), out=out)
+        return rc, out.getvalue()
+
+    def test_show_and_diff(self):
+        telemetry.record_collective("allreduce", "split", 512, "float32")
+        with tempfile.TemporaryDirectory() as tmp:
+            a = os.path.join(tmp, "a.json")
+            telemetry.report_json(a)
+            telemetry.record_collective("allreduce", "split", 512, "float32")
+            b = os.path.join(tmp, "b.json")
+            telemetry.report_json(b)
+            rc, text = self._cli("show", a)
+            self.assertEqual(rc, 0)
+            self.assertIn("allreduce", text)
+            rc, text = self._cli("diff", a, b)
+            self.assertEqual(rc, 0)
+            self.assertIn("collectives/allreduce/count", text)
+            self.assertIn("1 -> 2", text)
+
+    def test_validate_trace_subcommand(self):
+        with telemetry.span("cli"):
+            pass
+        with tempfile.TemporaryDirectory() as tmp:
+            good = os.path.join(tmp, "good.json")
+            telemetry.export_trace(good)
+            rc, text = self._cli("validate-trace", good)
+            self.assertEqual(rc, 0, text)
+            self.assertIn("OK", text)
+            bad = os.path.join(tmp, "bad.json")
+            with open(bad, "w") as fh:
+                json.dump({"traceEvents": [{"name": "x"}]}, fh)
+            rc, text = self._cli("validate-trace", bad)
+            self.assertEqual(rc, 1)
+            self.assertIn("INVALID", text)
+
+    def test_cli_proxy_delegates_to_core(self):
+        cli = self._cli_module
+        self.assertIs(cli.report, telemetry.report)
+        self.assertEqual(cli._MODE, telemetry._MODE)  # live proxy, not a copy
+
+
+@unittest.skipUnless(fusion.active(), "fusion disabled via HEAT_TPU_FUSION")
+class TestTimelineOverheadSafety(TimelineCase):
+    def test_verbose_emission_never_forces(self):
+        # event emission must not force a pending chain or add a sync
+        a = self._split_input(seed=71)
+        x = ht.exp(a * 0.5) + 1.0
+        self.assertTrue(fusion.is_deferred(x))
+        telemetry.report()  # report walks fusion/program state
+        telemetry.export_trace()  # and the exporter walks events
+        telemetry.program_costs()  # and the estimator lowers signatures
+        self.assertTrue(fusion.is_deferred(x))  # still pending: nothing forced
+        self.assertEqual(telemetry.async_forcing()["blocking_total"], 0)

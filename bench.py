@@ -574,6 +574,30 @@ def worker() -> None:
     except Exception:  # noqa: BLE001 - diagnostics must never cost the record
         pass
 
+    # static-analysis leg (heat_tpu/analysis, ISSUE 7): the AST lint's wall
+    # time over the library (the pre-commit budget a CI hook would pay) and
+    # the AOT program auditor's finding count over the program cache the
+    # measurements above just warmed — a nonzero audit_findings means a
+    # measured workload replicated a split input or broke collective parity.
+    # Runs AFTER the record is banked (hang-safety invariant).
+    try:
+        from heat_tpu import analysis as _analysis
+
+        _repo = os.path.dirname(os.path.abspath(__file__))
+        start = time.perf_counter()
+        _lint = _analysis.lint_paths([os.path.join(_repo, "heat_tpu")])
+        record["lint_ms"] = round((time.perf_counter() - start) * 1e3, 1)
+        record["lint_findings"] = sum(
+            1 for f in _lint if not f.suppressed and not f.baselined
+        )
+        _audit = _analysis.audit_programs(top=24)
+        record["audit_findings"] = len(_audit)
+        if _audit:  # name the worst offender so the artifact is actionable
+            record["audit_worst"] = _audit[0].as_dict()
+        print(json.dumps(record), flush=True)  # last parseable line wins
+    except Exception:  # noqa: BLE001 - diagnostics must never cost the record
+        pass
+
     # checkpoint subsystem (utils/checkpoint.py): manifest-based sharded
     # save + verified restore of a trainer-shaped pytree (a split DNDarray
     # riding per-shard files + replicated param/opt leaves + scalars).

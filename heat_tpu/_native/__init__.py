@@ -43,8 +43,8 @@ def _build() -> bool:
     try:
         res = subprocess.run(cmd, capture_output=True, timeout=120)
         return res.returncode == 0
-    except Exception:
-        return False
+    except (OSError, subprocess.SubprocessError, ValueError):
+        return False  # no g++ / timeout / bad argv: fallbacks own the data path
 
 
 def _load() -> Optional[ctypes.CDLL]:

@@ -1,0 +1,66 @@
+"""SPMD hazard analyzer: AST lint (H001-H005) + AOT sharded-program audit.
+
+Heat's SPMD model — every host runs the same script, one ``split`` axis
+expresses distribution, forcing is asynchronous — turns whole bug classes
+structural: a collective under host-divergent control flow deadlocks the
+mesh, an implicit blocking sync in a loop destroys the async-forcing
+pipeline, a dropped sharding constraint replicates O(n) onto every host.
+None of these fail a unit test; they hang or OOM at scale. This subsystem
+catches them statically, in two passes:
+
+* **Pass 1 — the lint** (:mod:`heat_tpu.analysis.rules`): a custom AST rule
+  engine over Python source with SPMD-specific rules H001-H005, inline
+  ``# heat-lint: disable=HXXX`` suppressions and a committed fingerprint
+  baseline (:mod:`heat_tpu.analysis.engine`). Pure standard library —
+  importing it never touches jax.
+* **Pass 2 — the audit** (:mod:`heat_tpu.analysis.audit`): every cached
+  sharded program is AOT-lowered from its abstract signature (the memoized
+  ``fusion.program_costs`` machinery; nothing executes) and checked for
+  replication blowups, collective-parity divergence across program
+  variants, and declared bytes-on-wire budgets.
+
+``python -m heat_tpu.analysis`` is the CLI (``lint`` / ``audit`` /
+``rules``); ``scripts/test_matrix.sh`` runs both as its analysis leg.
+"""
+
+from .engine import (
+    Finding,
+    LintError,
+    apply_baseline,
+    baseline_entries,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    render_findings,
+    summarize,
+    write_baseline,
+)
+from .rules import RULES, rule_table
+
+__all__ = [
+    "AuditFinding",
+    "Finding",
+    "LintError",
+    "RULES",
+    "apply_baseline",
+    "audit_programs",
+    "baseline_entries",
+    "lint_paths",
+    "lint_source",
+    "load_baseline",
+    "render_findings",
+    "rule_table",
+    "summarize",
+    "warm_bench_cache",
+    "write_baseline",
+]
+
+
+def __getattr__(name):
+    # the audit half imports jax lazily; keep `heat_tpu.analysis` importable
+    # (and the lint instant) on machines with no accelerator stack
+    if name in ("AuditFinding", "audit_programs", "warm_bench_cache", "render_audit"):
+        from . import audit as _audit
+
+        return getattr(_audit, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,206 @@
+"""``python -m heat_tpu.analysis`` — the SPMD hazard analyzer CLI.
+
+.. code-block:: console
+
+    $ python -m heat_tpu.analysis lint heat_tpu examples
+    $ python -m heat_tpu.analysis lint heat_tpu examples --baseline
+    $ python -m heat_tpu.analysis lint --write-baseline heat-lint-baseline.json heat_tpu examples
+    $ python -m heat_tpu.analysis audit --warm bench --devices 8
+    $ python -m heat_tpu.analysis rules
+
+``lint`` is pure AST analysis (no jax import, runs anywhere); ``audit``
+AOT-lowers the cached sharded programs, so it brings up the (CPU-forced, or
+real) mesh — ``--devices N`` forces an N-device host-platform mesh exactly
+like the test matrix does.
+
+Exit codes: 0 = clean (or only suppressed/baselined findings), 1 = active
+findings, 2 = usage/environment error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+from typing import List, Optional
+
+DEFAULT_BASELINE = "heat-lint-baseline.json"
+DEFAULT_PATHS = ["heat_tpu", "examples"]
+
+
+def _cmd_lint(args, out) -> int:
+    from . import engine
+
+    paths = args.paths or DEFAULT_PATHS
+    try:
+        findings = engine.lint_paths(paths, rules=args.rules)
+    except engine.LintError as exc:
+        print(f"heat-lint: {exc}", file=out)
+        return 2
+    if args.write_baseline is not None:
+        path = args.write_baseline or DEFAULT_BASELINE
+        doc = engine.write_baseline(path, findings)
+        print(
+            f"heat-lint: baseline with {len(doc['entries'])} finding(s) written to {path}",
+            file=out,
+        )
+        return 0
+    if args.baseline is not None:
+        try:
+            baseline = engine.load_baseline(args.baseline or DEFAULT_BASELINE)
+        except engine.LintError as exc:
+            print(f"heat-lint: {exc}", file=out)
+            return 2
+        engine.apply_baseline(findings, baseline)
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [f.as_dict() for f in findings],
+                    "summary": engine.summarize(findings),
+                },
+                indent=1,
+            ),
+            file=out,
+        )
+    else:
+        print(engine.render_findings(findings, show_suppressed=args.show_suppressed), file=out)
+    return 1 if engine.summarize(findings)["active"] else 0
+
+
+def _force_mesh(devices: int) -> None:
+    """Pin an N-device forced-host CPU mesh BEFORE the backend initializes
+    (the same knobs tests/conftest.py uses); a no-op if jax already started."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags).strip()
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={devices}".strip()
+    )
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _cmd_audit(args, out) -> int:
+    if args.devices:
+        _force_mesh(args.devices)
+    from . import audit as audit_mod
+
+    budgets = None
+    if args.budget:
+        try:
+            with open(args.budget) as fh:
+                budgets = json.load(fh)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"heat-audit: cannot read budget file {args.budget!r}: {exc}", file=out)
+            return 2
+    if args.warm == "bench":
+        t0 = time.perf_counter()
+        cached = audit_mod.warm_bench_cache()
+        print(
+            f"heat-audit: warmed {cached} program(s) with the bench workloads "
+            f"in {time.perf_counter() - t0:.1f}s",
+            file=out,
+        )
+    from heat_tpu.core import fusion
+
+    audited = len(fusion.cache_stats()["program_keys"])
+    findings = audit_mod.audit_programs(
+        factor=args.factor, min_bytes=args.min_bytes, budgets=budgets, top=args.top
+    )
+    if args.format == "json":
+        print(
+            json.dumps({"findings": [f.as_dict() for f in findings], "audited": audited}, indent=1),
+            file=out,
+        )
+    else:
+        print(audit_mod.render_audit(findings, audited), file=out)
+    return 1 if findings else 0
+
+
+def _cmd_rules(args, out) -> int:
+    from .rules import rule_table
+
+    for rec in rule_table():
+        print(f"{rec['id']}  [{rec['severity']:<7}] {rec['title']}", file=out)
+        print(f"      why:  {rec['rationale']}", file=out)
+        print(f"      fix:  {rec['hint']}", file=out)
+    return 0
+
+
+def main(argv: Optional[List[str]] = None, out=None) -> int:
+    out = out if out is not None else sys.stdout
+    parser = argparse.ArgumentParser(
+        prog="python -m heat_tpu.analysis",
+        description="SPMD hazard analyzer: AST lint (H001-H005) + AOT sharded-program audit.",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_lint = sub.add_parser("lint", help="lint Python sources for SPMD hazards")
+    p_lint.add_argument("paths", nargs="*", help=f"files/dirs (default: {' '.join(DEFAULT_PATHS)})")
+    p_lint.add_argument(
+        "--baseline",
+        nargs="?",
+        const=DEFAULT_BASELINE,
+        default=None,
+        metavar="FILE",
+        help=f"fail only on findings NOT in this baseline (default file: {DEFAULT_BASELINE})",
+    )
+    p_lint.add_argument(
+        "--write-baseline",
+        nargs="?",
+        const=DEFAULT_BASELINE,
+        default=None,
+        metavar="FILE",
+        help="write the current findings as the new baseline and exit 0",
+    )
+    p_lint.add_argument("--rules", help="comma list of rule ids to run (default: all)")
+    p_lint.add_argument("--format", choices=("text", "json"), default="text")
+    p_lint.add_argument(
+        "--show-suppressed", action="store_true", help="also print suppressed/baselined findings"
+    )
+
+    p_audit = sub.add_parser("audit", help="AOT-audit the cached sharded programs")
+    p_audit.add_argument(
+        "--devices", type=int, default=0, help="force an N-device host-platform CPU mesh"
+    )
+    p_audit.add_argument(
+        "--warm",
+        choices=("none", "bench"),
+        default="none",
+        help="'bench' warms the cache with the bench-shaped workloads first",
+    )
+    p_audit.add_argument(
+        "--factor",
+        type=float,
+        default=None,
+        help="replication-blowup threshold: per-host bytes-accessed >= FACTOR x sharded lower bound",
+    )
+    p_audit.add_argument(
+        "--min-bytes", type=int, default=None, help="ignore programs smaller than this"
+    )
+    p_audit.add_argument("--budget", metavar="FILE", help="JSON family-glob -> collective/wire-bytes budgets")
+    p_audit.add_argument("--top", type=int, default=None, help="audit only the top-N programs by dispatches")
+    p_audit.add_argument("--format", choices=("text", "json"), default="text")
+
+    sub.add_parser("rules", help="print the rule table")
+
+    args = parser.parse_args(argv)
+    if args.cmd == "lint":
+        return _cmd_lint(args, out)
+    if args.cmd == "audit":
+        from . import audit as audit_mod
+
+        if args.factor is None:
+            args.factor = audit_mod.DEFAULT_FACTOR
+        if args.min_bytes is None:
+            args.min_bytes = audit_mod.DEFAULT_MIN_BYTES
+        return _cmd_audit(args, out)
+    if args.cmd == "rules":
+        return _cmd_rules(args, out)
+    return 2  # pragma: no cover - argparse enforces the subcommands
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via subprocess in CI
+    sys.exit(main())

@@ -9,6 +9,7 @@ compiled as one XLA kernel.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional, Union
 
 import jax
@@ -47,6 +48,22 @@ def _kmeanspp_fixed(key: jax.Array, data: jax.Array, k: int, metric) -> jax.Arra
 
     centers, _ = jax.lax.fori_loop(1, k, body, (centers0, key))
     return centers
+
+
+@functools.lru_cache(maxsize=64)
+def _batchparallel_kernel(axis_name: str, k: int, metric):
+    """One stable batch-parallel-init kernel per (mesh axis, k, metric) —
+    the PRNG key is a kernel OPERAND, not a closure constant, so re-inits
+    with fresh seeds reuse the same compiled program (H004 contract)."""
+
+    def kernel(block, key):
+        idx = jax.lax.axis_index(axis_name)
+        local = _kmeanspp_fixed(jax.random.fold_in(key, idx), block, k, metric)
+        cands = jax.lax.all_gather(local, axis_name, tiled=True)  # (p*k, f)
+        return _kmeanspp_fixed(key, cands, k, metric)
+
+    kernel.__name__ = f"batchparallel_init_k{k}"
+    return kernel
 
 
 class _KCluster(ClusteringMixin, BaseEstimator):
@@ -150,18 +167,14 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         communication budget, vs the per-step sampling sync of plain
         kmeans++. The whole init is one XLA program."""
         comm = x.comm
-        metric = self._metric
         seed = int(ht_random.randint(0, 2**31 - 1, (1,)).larray[0])
         base_key = jax.random.PRNGKey(seed)
-        axis = comm.axis_name
-
-        def kernel(block):
-            idx = jax.lax.axis_index(axis)
-            local = _kmeanspp_fixed(jax.random.fold_in(base_key, idx), block, k, metric)
-            cands = jax.lax.all_gather(local, axis, tiled=True)  # (p*k, f)
-            return _kmeanspp_fixed(base_key, cands, k, metric)
-
-        return comm.apply(kernel, data, in_splits=[0], out_splits=None)
+        # the PRNG key rides as an OPERAND: a per-call closure over it would
+        # bake the key into the traced program as a constant and retrace
+        # every init (the H004 bug class) — the cached kernel is keyed on
+        # (axis, k, metric) only and every seed hits the same program
+        kernel = _batchparallel_kernel(comm.axis_name, k, self._metric)
+        return comm.apply(kernel, data, base_key, in_splits=[0, None], out_splits=None)
 
     def _assign_to_cluster(self, x: DNDarray):
         """Cluster id per sample (reference _kcluster.py:196-209)."""

@@ -98,7 +98,7 @@ class KMeans(_KCluster):
             init = "probability_based"
         self.use_fused = use_fused
         super().__init__(
-            metric=lambda x, y: _sq_dist(x, y),
+            metric=_sq_dist,  # module-level identity: kernels cache across instances
             n_clusters=n_clusters,
             init=init,
             max_iter=max_iter,

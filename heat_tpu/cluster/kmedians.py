@@ -66,7 +66,7 @@ class KMedians(_KCluster):
         if isinstance(init, str) and init in ("kmeans++", "k-means++"):
             init = "probability_based"
         super().__init__(
-            metric=lambda x, y: _sq_dist(x, y),
+            metric=_sq_dist,  # module-level identity: kernels cache across instances
             n_clusters=n_clusters,
             init=init,
             max_iter=max_iter,

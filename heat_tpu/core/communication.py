@@ -255,6 +255,28 @@ class Communication:
         raise NotImplementedError()
 
 
+@functools.lru_cache(maxsize=512)
+def _apply_program(mesh, kernel, in_specs, out_specs, check_vma):
+    """One jitted shard_map program per (mesh, kernel identity, layout) —
+    ``MeshCommunication.apply`` used to build a fresh ``jax.jit(shard_map)``
+    wrapper per call, which retraced even for a module-level kernel. With
+    the program memoized, a STABLE kernel identity (module-level function or
+    lru-cached factory — the H004 lint contract) makes repeat applies hit
+    compiled code; a per-call closure still misses every time, which is
+    exactly what the retrace ledger (``record_compile``) now counts."""
+    if telemetry._MODE:
+        telemetry.record_compile("apply:" + getattr(kernel, "__name__", "kernel"))
+    return jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    )
+
+
 class MeshCommunication(Communication):
     """A communication context backed by a 1-D JAX device mesh.
 
@@ -464,26 +486,14 @@ class MeshCommunication(Communication):
             out_specs = tuple(prefix_spec(s) for s in out_splits)
         else:
             out_specs = prefix_spec(out_splits)
-        if telemetry._MODE:
-            # each apply() builds (and traces) a fresh jit program — the
-            # retrace ledger keys them by kernel so repeat offenders show up
-            # (record_compile also lands a "compile" event on the timeline)
-            telemetry.record_compile("apply:" + getattr(kernel, "__name__", "kernel"))
         if resilience._ARMED:
             resilience.check("collective.apply")
-        fn = jax.jit(
-            jax.shard_map(
-                kernel,
-                mesh=self.mesh,
-                in_specs=in_specs,
-                out_specs=out_specs,
-                check_vma=check_vma,
-            )
-        )
+        fn = _apply_program(self.mesh, kernel, in_specs, out_specs, check_vma)
         if telemetry._MODE >= 2:
-            # time the build+trace+first-execute wall on the timeline: eager
-            # apply kernels are exactly the dispatches the fused path avoids,
-            # so their cost should be visible next to the fused programs'
+            # time the dispatch wall (build+trace+first-execute on a program
+            # cache miss) on the timeline: eager apply kernels are exactly
+            # the dispatches the fused path avoids, so their cost should be
+            # visible next to the fused programs'
             # (lazy import: utils depends on core, never the other way)
             from ..utils.profiling import Timer
 
@@ -512,8 +522,8 @@ def _distributed_client_live() -> bool:
     try:
         state = jax._src.distributed.global_state
         return getattr(state, "client", None) is not None
-    except Exception:
-        return False
+    except (AttributeError, ImportError):
+        return False  # private-module layout changed: read as "not connected"
 
 
 def initialize(

@@ -1189,8 +1189,8 @@ def _ensure_split(array: jax.Array, split: Optional[int], comm: MeshCommunicatio
         try:
             if current.is_equivalent_to(target, array.ndim):
                 return array
-        except Exception:
-            pass
+        except (TypeError, ValueError, AttributeError):
+            pass  # sharding types without a comparable form: place anew
     return jax.device_put(array, target)
 
 

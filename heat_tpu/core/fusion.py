@@ -115,6 +115,7 @@ __all__ = [
     "cache_stats",
     "clear_cache",
     "clear_quarantine",
+    "program_audit_info",
     "program_costs",
     "program_hlo",
     "programs",
@@ -366,6 +367,11 @@ _QUARANTINE: "OrderedDict[tuple, None]" = OrderedDict()
 _PROGRAM_INFO: "OrderedDict[tuple, dict]" = OrderedDict()
 # memoized cost estimates keyed by program key (program_costs())
 _COSTS: dict = {}
+# memoized everything-replicated cost estimates keyed by program key — the
+# audit baseline: "what would this program cost per host if nothing were
+# sharded" (heat_tpu/analysis/audit.py divides by the mesh size to get the
+# sharded lower bound a replication blowup is measured against)
+_REPL_COSTS: dict = {}
 _STATS = {
     "compiles": 0,
     "hits": 0,
@@ -755,6 +761,7 @@ def clear_cache() -> None:
     _PROGRAMS.clear()
     _PROGRAM_INFO.clear()
     _COSTS.clear()
+    _REPL_COSTS.clear()
     _QUARANTINE.clear()
     _LIVE_ROOTS.clear()
     _STATS.update(
@@ -1160,17 +1167,39 @@ def programs() -> dict:
         rec = {k: v for k, v in info.items() if k != "key"}
         cost = _COSTS.get(info["key"])
         if cost is not None:
-            rec["cost"] = dict(cost)
+            # the raw HLO instruction lines are audit-only detail: merged
+            # into report()/the metrics sink they would bloat every flush
+            # with multi-hundred-char strings per program
+            rec["cost"] = {k: v for k, v in cost.items() if k != "collective_lines"}
         out[info["key"]] = rec
     return out
 
 
-def _leaf_placeholder(entry):
+def _replicated_like(sharding):
+    """The fully-replicated sharding over the SAME mesh as ``sharding`` (the
+    audit's everything-replicated lowering keeps per-host semantics exact by
+    replicating over the identical device set), or None when the sharding
+    type cannot express one."""
+    if sharding is None:
+        return None
+    try:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(sharding.mesh, PartitionSpec())
+    except Exception:  # noqa: BLE001 - non-Named shardings degrade to unsharded
+        return None
+
+
+def _leaf_placeholder(entry, replicated: bool = False):
     """An abstract stand-in for one signature leaf: sharded
     ``ShapeDtypeStruct`` for arrays, a zero of the recorded type for python
-    scalars — enough to AOT-lower the program without any live operand."""
+    scalars — enough to AOT-lower the program without any live operand.
+    ``replicated=True`` swaps the recorded sharding for its fully-replicated
+    form on the same mesh (the audit baseline)."""
     if entry[0] == "L":
         _, shape, dtype, sharding = entry
+        if replicated:
+            sharding = _replicated_like(sharding)
         try:
             return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
         except Exception:  # noqa: BLE001 - sharding kwarg availability varies
@@ -1181,15 +1210,17 @@ def _leaf_placeholder(entry):
         return 0
 
 
-def _estimate_cost(sig) -> dict:
+def _estimate_cost(sig, replicated: bool = False) -> dict:
     """Best-effort cost estimate of one cached program, from its signature
     alone: logical operand/result bytes from the recorded avals, flops and
     bytes-accessed from XLA's post-compile cost analysis, and the in-program
     collective instruction counts parsed from the optimized HLO
     (``telemetry.hlo_collective_counts``). Re-lowers the signature from
-    abstract specs — an extra compile, which is why callers memoize."""
+    abstract specs — an extra compile, which is why callers memoize.
+    ``replicated=True`` lowers with every array leaf fully replicated over
+    its mesh instead — the denominator of the audit's replication check."""
     leaves = [e for e in sig if e[0] in ("L", "Ls")]
-    specs = [_leaf_placeholder(e) for e in leaves]
+    specs = [_leaf_placeholder(e, replicated) for e in leaves]
     cost: dict = {
         "operand_bytes": 0,
         "result_bytes": None,
@@ -1217,7 +1248,14 @@ def _estimate_cost(sig) -> dict:
         return cost
     try:
         compiled = jax.jit(_build(sig)).lower(*specs).compile()
-        cost["collectives"] = telemetry.hlo_collective_counts(compiled.as_text())
+        hlo_text = compiled.as_text()
+        entries = telemetry.hlo_collectives(hlo_text)
+        cost["collectives"] = {}
+        for entry in entries:
+            cost["collectives"][entry["op"]] = cost["collectives"].get(entry["op"], 0) + 1
+        # the raw instruction lines carry the payload shapes — the audit's
+        # bytes-on-wire estimate parses them (analysis/audit.py)
+        cost["collective_lines"] = [entry["line"] for entry in entries]
         analysis = compiled.cost_analysis()
         if isinstance(analysis, (list, tuple)):
             analysis = analysis[0] if analysis else {}
@@ -1249,9 +1287,73 @@ def program_costs(top: Optional[int] = None, refresh: bool = False) -> dict:
         cost = None if refresh else _COSTS.get(key)
         if cost is None:
             cost = _COSTS[key] = _estimate_cost(sig)
+        public = {k: v for k, v in cost.items() if k != "collective_lines"}
         out[key] = dict(
-            cost, family=info["family"], dispatches=info["dispatches"]
+            public, family=info["family"], dispatches=info["dispatches"]
         )
+    return out
+
+
+def program_audit_info(top: Optional[int] = None, refresh: bool = False) -> dict:
+    """Audit-grade introspection of every cached sharded program, keyed by
+    program key (the AOT seam ``heat_tpu/analysis/audit.py`` reasons over):
+    the op ``family``, ``dispatches``, the recorded ``leaves`` (shape/dtype/
+    replicated flag), the leaf ``mesh_size`` and ``split_leaves`` count, the
+    memoized :func:`_estimate_cost` under the recorded shardings (``cost``)
+    and under everything-replicated shardings (``replicated_cost``) — the
+    pair whose ratio exposes a replication blowup without depending on the
+    chain's depth. Lowers from abstract specs only: never touches live data
+    or forces a pending chain."""
+    ranked = sorted(
+        _PROGRAM_INFO.items(), key=lambda kv: kv[1]["dispatches"], reverse=True
+    )
+    if top is not None:
+        ranked = ranked[:top]
+    out = {}
+    for sig, info in ranked:
+        key = info["key"]
+        cost = None if refresh else _COSTS.get(key)
+        if cost is None:
+            cost = _COSTS[key] = _estimate_cost(sig)
+        rcost = None if refresh else _REPL_COSTS.get(key)
+        if rcost is None:
+            rcost = _REPL_COSTS[key] = _estimate_cost(sig, replicated=True)
+        leaves = []
+        mesh_size = 1
+        split_leaves = 0
+        for e in sig:
+            if e[0] != "L":
+                continue
+            sharding = e[3]
+            replicated = True
+            if sharding is not None:
+                try:
+                    mesh_size = max(mesh_size, len(sharding.device_set))
+                    replicated = bool(sharding.is_fully_replicated)
+                except Exception:  # noqa: BLE001 - sharding APIs vary by type
+                    pass
+            if not replicated:
+                split_leaves += 1
+            size = 1
+            for s in e[1]:
+                size *= int(s)
+            leaves.append(
+                {
+                    "shape": tuple(int(s) for s in e[1]),
+                    "dtype": str(np.dtype(e[2])),
+                    "nbytes": size * np.dtype(e[2]).itemsize,
+                    "replicated": replicated,
+                }
+            )
+        out[key] = {
+            "family": info["family"],
+            "dispatches": info["dispatches"],
+            "mesh_size": mesh_size,
+            "split_leaves": split_leaves,
+            "leaves": leaves,
+            "cost": dict(cost),
+            "replicated_cost": dict(rcost),
+        }
     return out
 
 

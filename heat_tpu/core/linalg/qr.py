@@ -34,7 +34,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from .. import factories, sanitation, telemetry, types
+from .. import factories, resilience, sanitation, telemetry, types
 from ..communication import sanitize_comm
 from ..dndarray import DNDarray, _ensure_split
 
@@ -193,7 +193,13 @@ def _record_cholqr2_collectives(a: DNDarray) -> None:
     """Declared CholeskyQR2 schedule: each of the two passes' Gram
     contractions psums one (n, n) partial over the split axis (GSPMD inserts
     it when the operand rows are sharded; replicated operands move nothing)."""
-    if not telemetry._MODE or a.split != 0 or not a.comm.is_distributed():
+    if a.split != 0 or not a.comm.is_distributed():
+        return  # replicated operand: the Gram contractions move nothing
+    if resilience._ARMED:
+        # the declared schedule's fault site: fires exactly when the psums
+        # will actually ride the dispatch, telemetry on or off
+        resilience.check("collective.allreduce")
+    if not telemetry._MODE:
         return
     n = int(a.shape[1])
     acc = jnp.result_type(a.larray.dtype, jnp.float32)
@@ -248,6 +254,10 @@ def _tsqr(a: DNDarray, comm) -> Tuple[jax.Array, jax.Array]:
         phys = a.parray  # (p*block, n), zero rows past m
     block = int(phys.shape[0]) // p
     k1 = min(block, int(n))
+    if resilience._ARMED:
+        # the declared schedule's fault site (one in-kernel all_gather) —
+        # fires with the dispatch below, like the communication verbs
+        resilience.check("collective.allgather")
     if telemetry._MODE:
         # declared schedule: ONE all_gather of the p (k1, n) R factors
         telemetry.record_collective(
@@ -334,6 +344,9 @@ def _panel_qr_split1(a: DNDarray, comm) -> Tuple[jax.Array, jax.Array]:
         phys = a.parray  # (m, p*c), zero columns past n
     c = int(phys.shape[1]) // p
     n_pad = c * p
+    if resilience._ARMED:
+        # the declared schedule's fault site (per-panel in-kernel bcasts)
+        resilience.check("collective.bcast")
     if telemetry._MODE:
         # declared schedule: per panel, one (m, c) Q bcast + one (c, c) R bcast
         telemetry.record_collective(

@@ -20,7 +20,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from .. import factories, sanitation, telemetry
+from .. import factories, resilience, sanitation, telemetry
 from ..dndarray import DNDarray
 from .basics import dot, matmul, norm, transpose
 
@@ -213,6 +213,9 @@ def solve_triangular(A: DNDarray, b: DNDarray, lower: bool = False) -> DNDarray:
         NamedSharding(comm.mesh, PartitionSpec(comm.axis_name, None)),
     )
 
+    if resilience._ARMED:
+        # the declared schedule's fault site (per-stage in-kernel psums)
+        resilience.check("collective.allreduce")
     if telemetry._MODE:
         # declared schedule: one psum of one solved (rows_loc, k) block per stage
         telemetry.record_collective(

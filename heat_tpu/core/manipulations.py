@@ -703,6 +703,34 @@ def tile(x: DNDarray, reps) -> DNDarray:
     return _wrap(result, split, x)
 
 
+@functools.lru_cache(maxsize=256)
+def _topk_kernel(axis_name: str, size: int, dim: int, k: int, block: int, largest: bool):
+    """One stable top-k merge kernel per (mesh axis+size, dim, k, block,
+    largest) so ``comm.apply``'s program cache and the retrace ledger key it
+    like any other op — a per-call closure here retraced every ``topk`` call
+    (the H004 bug class; cf. ``fusion._apply_fn`` /
+    ``statistics._arg_reduce_kernel``). ``size`` is the static mesh-axis
+    size the custom-combiner allreduce folds over."""
+    from . import communication
+
+    def kernel(xs):
+        x_last = jnp.moveaxis(xs, dim, -1)
+        order = jnp.argsort(x_last, axis=-1, descending=largest, stable=True)
+        order = jnp.take(order, jnp.arange(k), axis=-1)
+        lv = jnp.take_along_axis(x_last, order, axis=-1)
+        li = order + jax.lax.axis_index(axis_name) * block
+        gv, gi = communication.allreduce(
+            (lv, li),
+            axis_name,
+            op=lambda p1, p2: mpi_topk(p1, p2, k, largest),
+            size=size,
+        )
+        return jnp.moveaxis(gv, -1, dim), jnp.moveaxis(gi, -1, dim)
+
+    kernel.__name__ = f"topk_merge_d{dim}_k{k}"
+    return kernel
+
+
 def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool = True, out=None):
     """Top-k values and indices along a dimension (reference
     manipulations.py:3834-3984 + the custom mpi_topk merge :3985-4028; XLA's
@@ -722,22 +750,9 @@ def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool 
         and a.comm.size > 1
         and k <= a.shape[dim] // a.comm.size
     ):
-        import jax
-
         comm = a.comm
         block = a.shape[dim] // comm.size
-
-        def kernel(xs):
-            x_last = jnp.moveaxis(xs, dim, -1)
-            order = jnp.argsort(x_last, axis=-1, descending=largest, stable=True)
-            order = jnp.take(order, jnp.arange(k), axis=-1)
-            lv = jnp.take_along_axis(x_last, order, axis=-1)
-            li = order + jax.lax.axis_index(comm.axis_name) * block
-            gv, gi = comm.allreduce(
-                (lv, li), op=lambda p1, p2: mpi_topk(p1, p2, k, largest)
-            )
-            return jnp.moveaxis(gv, -1, dim), jnp.moveaxis(gi, -1, dim)
-
+        kernel = _topk_kernel(comm.axis_name, comm.size, dim, k, block, largest)
         val, idx = comm.apply(kernel, a.larray, in_splits=[dim], out_splits=(None, None))
         v = _wrap(val, None, a)
         i = _wrap(idx.astype(types.index_dtype()), None, a)

@@ -162,6 +162,7 @@ __all__ = [
     "set_mode",
     "span",
     "spans",
+    "trace_collective_parity",
     "trace_events",
     "unfused_reasons",
     "validate_trace",
@@ -1432,12 +1433,24 @@ def export_trace(path: Optional[str] = None, events: Optional[List[dict]] = None
     return doc
 
 
-def merge_traces(paths: List[str], path: Optional[str] = None, align: bool = True) -> Dict[str, Any]:
+def merge_traces(
+    paths: List[str],
+    path: Optional[str] = None,
+    align: bool = True,
+    check_parity: bool = False,
+) -> Dict[str, Any]:
     """Stitch per-host trace files (one :func:`export_trace` output per
     controller) into a single multi-process trace: each input keeps its own
     process row (re-pid'd by input order on collision), and ``align=True``
     shifts every input so its earliest timestamp sits at zero — perf_counter
-    epochs differ across hosts, so only relative time is meaningful."""
+    epochs differ across hosts, so only relative time is meaningful.
+
+    ``check_parity=True`` runs :func:`trace_collective_parity` over the
+    merged document — per-cid collective event counts must match across
+    process rows (SPMD: every host records the same collectives). Problems
+    warn and land under ``otherData["collective_parity"]``; they are the
+    runtime signature of an H001 deadlock hazard (one host entered a
+    collective its peers never recorded)."""
     merged: List[dict] = []
     seen_pids: set = set()
     for i, p in enumerate(paths):
@@ -1465,6 +1478,17 @@ def merge_traces(paths: List[str], path: Optional[str] = None, align: bool = Tru
         "displayTimeUnit": "ms",
         "otherData": {"tool": "heat_tpu.telemetry", "merged_from": len(paths)},
     }
+    if check_parity:
+        problems = trace_collective_parity(doc)
+        if problems:
+            doc["otherData"]["collective_parity"] = problems
+            warnings.warn(
+                "merged trace fails cross-host collective parity "
+                f"({len(problems)} problem(s), first: {problems[0]}) — the runtime "
+                "signature of a collective under host-divergent control flow "
+                "(heat-lint H001)",
+                stacklevel=2,
+            )
     if path is not None:
         with open(path, "w") as fh:
             json.dump(doc, fh)
@@ -1472,18 +1496,77 @@ def merge_traces(paths: List[str], path: Optional[str] = None, align: bool = Tru
     return doc
 
 
-def validate_trace(doc_or_path) -> List[str]:
+def _load_trace_doc(doc_or_path):
+    if not isinstance(doc_or_path, str):
+        return doc_or_path, None
+    try:
+        with open(doc_or_path) as fh:
+            return json.load(fh), None
+    except Exception as exc:  # noqa: BLE001 - the problem IS the result
+        return None, f"not valid JSON: {exc!r}"
+
+
+def trace_collective_parity(doc_or_path) -> List[str]:
+    """Cross-host collective parity of a (merged) trace: for every process
+    row, count collective-category events keyed by (name, correlation id)
+    and require identical multisets across rows. Under SPMD every host runs
+    the same script, so per-host cid sequences align and each host must have
+    recorded exactly the same collectives — a row missing (or holding extra)
+    collective events is the already-exported-trace signature of the H001
+    deadlock hazard: some hosts entered a collective the others never
+    reached. Returns problem strings (empty = parity holds); single-row
+    traces trivially pass."""
+    doc, err = _load_trace_doc(doc_or_path)
+    if err is not None:
+        return [err]
+    if not isinstance(doc, dict) or not isinstance(doc.get("traceEvents"), list):
+        return ["missing traceEvents list"]
+    per_pid: Dict[Any, Dict[tuple, int]] = {}
+    for ev in doc["traceEvents"]:
+        if not isinstance(ev, dict):
+            continue
+        ph = ev.get("ph")
+        if ph == "M":
+            # a process row exists even if it recorded nothing — an empty
+            # row must still be compared (its silence IS the finding)
+            per_pid.setdefault(ev.get("pid", 0), {})
+            continue
+        if ev.get("cat") != "collective":
+            continue
+        args = ev.get("args") or {}
+        key = (str(ev.get("name")), args.get("cid"))
+        counts = per_pid.setdefault(ev.get("pid", 0), {})
+        counts[key] = counts.get(key, 0) + 1
+    if len(per_pid) < 2:
+        return []
+    problems: List[str] = []
+    pids = sorted(per_pid, key=str)
+    ref_pid, ref = pids[0], per_pid[pids[0]]
+    for pid in pids[1:]:
+        counts = per_pid[pid]
+        for key in sorted(set(ref) | set(counts), key=str):
+            a, b = ref.get(key, 0), counts.get(key, 0)
+            if a != b:
+                name, cid = key
+                where = f"collective {name!r}" + (f" cid {cid}" if cid is not None else "")
+                problems.append(
+                    f"{where}: host {ref_pid} recorded {a} event(s) but host {pid} "
+                    f"recorded {b} — hosts diverged around this collective"
+                )
+    return problems
+
+
+def validate_trace(doc_or_path, cross_host: bool = False) -> List[str]:
     """Structural problems of a Chrome trace-event document (or file path):
     empty list = loads and every event carries the required keys. The CLI's
-    ``validate-trace`` and the CI matrix leg assert on this."""
+    ``validate-trace`` and the CI matrix leg assert on this.
+    ``cross_host=True`` additionally runs :func:`trace_collective_parity`
+    (the ``validate-trace --cross-host`` CLI flag) so an exported multi-host
+    trace surfaces the runtime signature of an H001 deadlock."""
     problems: List[str] = []
-    doc = doc_or_path
-    if isinstance(doc_or_path, str):
-        try:
-            with open(doc_or_path) as fh:
-                doc = json.load(fh)
-        except Exception as exc:  # noqa: BLE001 - the problem IS the result
-            return [f"not valid JSON: {exc!r}"]
+    doc, err = _load_trace_doc(doc_or_path)
+    if err is not None:
+        return [err]
     if not isinstance(doc, dict) or not isinstance(doc.get("traceEvents"), list):
         return ["missing traceEvents list"]
     open_async: Dict[str, int] = {}
@@ -1510,6 +1593,8 @@ def validate_trace(doc_or_path) -> List[str]:
     for key, n in open_async.items():
         if n:
             problems.append(f"async begin without end (id {key})")
+    if cross_host:
+        problems.extend(trace_collective_parity(doc))
     return problems
 
 
@@ -1555,7 +1640,11 @@ class _MetricsSink:
                 fh.write(line + "\n")
             self.lines += 1
             return True
-        except Exception:  # noqa: BLE001 - observability must never take the job down
+        # swallowing is this sink's contract: the flush runs on a daemon
+        # thread and at exit; ANY failure (full disk, revoked mount,
+        # interpreter teardown) must drop the metrics line, never the job
+        # heat-lint: disable=H003 — observability must never take the job down
+        except Exception:  # noqa: BLE001
             return False
 
     def stop(self, final: bool = True) -> None:

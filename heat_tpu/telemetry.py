@@ -188,6 +188,13 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
         "validate-trace", help="check a Chrome/Perfetto trace-event JSON file"
     )
     p_val.add_argument("trace", help="path to an export_trace/merge_traces output")
+    p_val.add_argument(
+        "--cross-host",
+        action="store_true",
+        help="also require cross-host collective parity (per-cid collective event "
+        "counts equal on every process row — the runtime signature of an H001 "
+        "deadlock when violated)",
+    )
     args = parser.parse_args(argv)
 
     if args.cmd == "show":
@@ -201,14 +208,18 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
         _diff(_load(args.a), _load(args.b), out)
         return 0
     if args.cmd == "validate-trace":
-        problems = _core.validate_trace(args.trace)
+        problems = _core.validate_trace(args.trace, cross_host=args.cross_host)
         if problems:
             for p in problems[:20]:
                 print(f"INVALID: {p}", file=out)
             return 1
         with open(args.trace) as fh:
             n = len(json.load(fh).get("traceEvents", []))
-        print(f"OK: {args.trace} parses as trace-event JSON ({n} events)", file=out)
+        parity = " + cross-host collective parity" if args.cross_host else ""
+        print(
+            f"OK: {args.trace} parses as trace-event JSON ({n} events){parity}",
+            file=out,
+        )
         return 0
     return 2  # pragma: no cover - argparse enforces the subcommands
 

@@ -68,6 +68,14 @@ HEAT_TPU_FAULTS=ci HEAT_TPU_TELEMETRY=1 \
   python -m pytest tests/test_resilience.py tests/test_resilience_io.py tests/test_io_errors.py \
     tests/test_checkpoint_resilience.py tests/test_checkpoint_profiling.py \
     tests/test_fused_collectives.py tests/test_trace_timeline.py -q -x
+# static-analysis leg (heat_tpu/analysis): the AST lint must be clean
+# against the committed baseline (zero NEW findings — suppressions carry
+# their justifications inline), and the AOT program auditor over a cache
+# warmed with the bench-shaped workloads at mesh 8 must report zero
+# replication-blowup / collective-parity / budget findings
+echo "=== static analysis (heat-lint + program audit) ==="
+python -m heat_tpu.analysis lint heat_tpu examples --baseline heat-lint-baseline.json
+python -m heat_tpu.analysis audit --warm bench --devices 8
 # the coverage gate (reference codecov.yml target semantics): the merged
 # matrix coverage must clear the floor or the matrix run fails. On runtimes
 # without sys.monitoring (Python < 3.12) no cov_mesh*.json legs are produced

@@ -574,6 +574,58 @@ def worker() -> None:
     except Exception:  # noqa: BLE001 - diagnostics must never cost the record
         pass
 
+    # memory-observability leg (core/memledger.py, ISSUE 8): the live-buffer
+    # ledger's dispatch-rate cost (sampling hooks on vs off, telemetry on,
+    # ALTERNATING best-of rounds — contract <= 5%, banked as
+    # memory_ledger_overhead_pct), the workloads' high watermark
+    # (peak_live_bytes), and the static per-host memory peak of a resplit
+    # program (resplit_peak_bytes — the gauge ROADMAP 3's O(n/p) rewrite
+    # will be asserted against). Runs AFTER the record is banked
+    # (hang-safety invariant).
+    try:
+        from heat_tpu.core import memledger as _memledger
+
+        if chain_fused:
+            with _telemetry.enabled():
+                ledger_on = ledger_off = 0.0
+                for _ in range(3):
+                    _memledger.set_enabled(False)
+                    try:
+                        ledger_off = max(ledger_off, _chain_rate())
+                    finally:
+                        _memledger.set_enabled(True)
+                    ledger_on = max(ledger_on, _chain_rate())
+            if ledger_off:
+                record["memory_ledger_overhead_pct"] = round(
+                    100.0 * (1.0 - ledger_on / ledger_off), 1
+                )
+        _memledger.sample("bench", force=True)
+        record["peak_live_bytes"] = int(_memledger.watermark()["bytes"])
+        # the resplit program's static peak: force a 0->1 redistribution of
+        # a split array and read the reshard program's XLA memory_analysis
+        # (today's un-pad -> re-pad -> constraint path can sit at O(n);
+        # arxiv 2112.01075's schedule should pull this toward O(n/p))
+        rs = ht.ones((2048 * max(1, ht.get_comm().size), 32), split=0) + 0.0
+        rs.resplit_(1)
+        float(rs.larray[0, 0])  # force the reshard program
+        _resplit_peak = None
+        # estimate ONLY the reshard program(s): program_costs() over the whole
+        # bench-warmed cache would pay one AOT compile per cached program
+        for _sig, _info in list(_fusion._PROGRAM_INFO.items()):
+            if "_reshard_op" not in _info["family"]:
+                continue
+            _cost = _fusion._COSTS.get(_info["key"])
+            if _cost is None:
+                _cost = _fusion._COSTS[_info["key"]] = _fusion._estimate_cost(_sig)
+            _mem = _cost.get("memory") or {}
+            if _mem.get("peak_bytes"):
+                _resplit_peak = max(_resplit_peak or 0, int(_mem["peak_bytes"]))
+        if _resplit_peak is not None:
+            record["resplit_peak_bytes"] = _resplit_peak
+        print(json.dumps(record), flush=True)  # last parseable line wins
+    except Exception:  # noqa: BLE001 - diagnostics must never cost the record
+        pass
+
     # static-analysis leg (heat_tpu/analysis, ISSUE 7): the AST lint's wall
     # time over the library (the pre-commit budget a CI hook would pay) and
     # the AOT program auditor's finding count over the program cache the

@@ -23,6 +23,7 @@ from .core import (
     io,
     logical,
     manipulations,
+    memledger,
     memory,
     printing,
     relational,

@@ -105,9 +105,29 @@ def _cmd_audit(args, out) -> int:
         )
     from heat_tpu.core import fusion
 
+    peak_budget = None
+    if args.peak_budget is not None:
+        from heat_tpu.core import memledger
+
+        try:
+            peak_budget = memledger.parse_budget(args.peak_budget)
+        except ValueError as exc:
+            print(f"heat-audit: bad --peak-budget {args.peak_budget!r}: {exc}", file=out)
+            return 2
+        if not isinstance(peak_budget, int):
+            print(
+                f"heat-audit: --peak-budget must be absolute bytes "
+                f"(got {args.peak_budget!r})",
+                file=out,
+            )
+            return 2
     audited = len(fusion.cache_stats()["program_keys"])
     findings = audit_mod.audit_programs(
-        factor=args.factor, min_bytes=args.min_bytes, budgets=budgets, top=args.top
+        factor=args.factor,
+        min_bytes=args.min_bytes,
+        budgets=budgets,
+        top=args.top,
+        peak_budget=peak_budget,
     )
     if args.format == "json":
         print(
@@ -180,7 +200,15 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
     p_audit.add_argument(
         "--min-bytes", type=int, default=None, help="ignore programs smaller than this"
     )
-    p_audit.add_argument("--budget", metavar="FILE", help="JSON family-glob -> collective/wire-bytes budgets")
+    p_audit.add_argument("--budget", metavar="FILE", help="JSON family-glob -> collective/wire-bytes/peak-bytes budgets")
+    p_audit.add_argument(
+        "--peak-budget",
+        metavar="BYTES",
+        default=None,
+        help="flag any program whose static memory peak (XLA memory_analysis, "
+        "per host) exceeds this — accepts KiB/MiB/GiB suffixes, the AOT form "
+        "of HEAT_TPU_MEMORY_BUDGET",
+    )
     p_audit.add_argument("--top", type=int, default=None, help="audit only the top-N programs by dispatches")
     p_audit.add_argument("--format", choices=("text", "json"), default="text")
 

@@ -25,6 +25,13 @@ the partitioned HLO can show:
   counts and/or total on-wire bytes estimated from the collective
   instructions' result shapes in the optimized HLO) are diffed via
   ``telemetry.collective_budget_excess``.
+* **Static memory peaks** — each program's XLA ``memory_analysis`` peak
+  (arguments + outputs + temps per host, banked by ``fusion._estimate_cost``
+  into ``cost["memory"]``) checked against a global ``--peak-budget``
+  ceiling and/or per-family ``"peak_bytes"`` budget entries: the AOT form
+  of the runtime ``HEAT_TPU_MEMORY_BUDGET`` admission gate
+  (``core/memledger.py``), catching the program that would be refused at
+  dispatch before anything runs it.
 
 Everything here imports jax lazily — ``heat_tpu.analysis`` stays importable
 (and the lint usable) on machines with no accelerator stack at all.
@@ -149,16 +156,52 @@ def audit_programs(
     min_bytes: int = DEFAULT_MIN_BYTES,
     budgets: Optional[Dict[str, dict]] = None,
     top: Optional[int] = None,
+    peak_budget: Optional[int] = None,
 ) -> List[AuditFinding]:
     """Audit every cached sharded program (see the module docstring for the
     three checks). ``budgets`` maps an op-family glob to
-    ``{"collectives": {type: max_count}, "wire_bytes": max_total}`` (either
-    key optional). Returns findings ranked errors-first. AOT only: nothing
-    is executed, no live array is touched."""
+    ``{"collectives": {type: max_count}, "wire_bytes": max_total,
+    "peak_bytes": max_static_peak}`` (every key optional); ``peak_budget``
+    applies one static-memory-peak ceiling (XLA ``memory_analysis``, per
+    host) to EVERY program — the AOT form of the runtime admission gate
+    (``HEAT_TPU_MEMORY_BUDGET``), catching a program that would blow the
+    budget before anything dispatches it. Returns findings ranked
+    errors-first. AOT only: nothing is executed, no live array is touched."""
     from heat_tpu.core import fusion, telemetry
 
     info = fusion.program_audit_info(top=top)
     findings: List[AuditFinding] = []
+
+    # static memory peaks vs the global ceiling
+    if peak_budget is not None:
+        for key, rec in info.items():
+            mem = rec["cost"].get("memory") or {}
+            peak = mem.get("peak_bytes")
+            if peak is None or peak <= peak_budget:
+                continue
+            findings.append(
+                AuditFinding(
+                    kind="memory",
+                    severity="error",
+                    program=key,
+                    family=rec["family"],
+                    message=(
+                        f"static memory peak {int(peak)} B exceeds the "
+                        f"{int(peak_budget)} B budget (arguments "
+                        f"{mem.get('argument_bytes')} + outputs "
+                        f"{mem.get('output_bytes')} + temps "
+                        f"{mem.get('temp_bytes')} per host) — this program "
+                        "would be refused (or OOM) at dispatch under "
+                        "HEAT_TPU_MEMORY_BUDGET of the same size"
+                    ),
+                    detail={
+                        "peak_bytes": int(peak),
+                        "budget": int(peak_budget),
+                        "memory": dict(mem),
+                        "dispatches": rec["dispatches"],
+                    },
+                )
+            )
 
     # replication blowups
     for key, rec in info.items():
@@ -250,6 +293,24 @@ def audit_programs(
                                 f"{pattern!r}: {excess}"
                             ),
                             detail={"counts": counts, "budget": allowed, "excess": excess},
+                        )
+                    )
+            max_peak = budget.get("peak_bytes")
+            if max_peak is not None:
+                peak = (rec["cost"].get("memory") or {}).get("peak_bytes")
+                if peak is not None and peak > max_peak:
+                    findings.append(
+                        AuditFinding(
+                            kind="budget",
+                            severity="error",
+                            program=key,
+                            family=rec["family"],
+                            message=(
+                                f"static memory peak budget exceeded for family "
+                                f"pattern {pattern!r}: {int(peak)} > {int(max_peak)} "
+                                "bytes per host (XLA memory_analysis)"
+                            ),
+                            detail={"peak_bytes": int(peak), "budget": int(max_peak)},
                         )
                     )
             max_wire = budget.get("wire_bytes")

@@ -19,6 +19,7 @@ from .stride_tricks import *
 from . import telemetry
 from . import resilience
 from .resilience import errstate
+from . import memledger
 from . import fusion
 from .dndarray import *
 from .factories import *

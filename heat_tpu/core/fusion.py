@@ -83,6 +83,17 @@ cross-check the compiled program.
 ``HEAT_TPU_FUSION_COLLECTIVES=0`` is the escape hatch restoring
 force-at-collective behavior (no collective nodes, no multi-root batching);
 ``HEAT_TPU_FUSION=0`` still disables recording entirely.
+
+Memory observability (``core/memledger.py``)
+--------------------------------------------
+The dispatch seam here is also the memory seam: every force's results are
+tagged into the live-buffer ledger (``fusion`` owner until a wrapper claims
+them), ``_estimate_cost`` banks XLA's ``memory_analysis`` static peaks per
+program, ``HEAT_TPU_MEMORY_BUDGET`` is checked before each dispatch
+(``warn``/``raise``/``drain`` policies — drain blocking-syncs the other
+outstanding async roots first), and a dispatch that dies of memory
+exhaustion (injectable at the ``memory.exhausted`` site) produces a ranked
+OOM forensic before degrading through the guarded path.
 """
 
 from __future__ import annotations
@@ -100,13 +111,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import resilience, telemetry
+from . import memledger, resilience, telemetry
 
 __all__ = [
     "LazyArray",
+    "ProgramCostWarning",
     "active",
     "collectives_active",
     "collectives_disabled",
+    "cost_error_count",
     "disabled",
     "defer_apply",
     "defer_reshard",
@@ -122,6 +135,13 @@ __all__ = [
     "register_root",
     "wrap_node",
 ]
+
+
+class ProgramCostWarning(UserWarning):
+    """A cached program's cost estimate failed in the backend (the estimate
+    carries ``cost["error"]``); failures are counted into
+    ``report()["programs"]["cost_errors"]`` and warned once per session —
+    never buried silently."""
 
 _OFF_VALUES = ("0", "false", "off", "no")
 
@@ -367,6 +387,11 @@ _QUARANTINE: "OrderedDict[tuple, None]" = OrderedDict()
 _PROGRAM_INFO: "OrderedDict[tuple, dict]" = OrderedDict()
 # memoized cost estimates keyed by program key (program_costs())
 _COSTS: dict = {}
+# program keys whose cost estimate failed in the backend: the failure used
+# to be buried silently in cost["error"] — now it is counted into
+# report()["programs"]["cost_errors"] and warned once per session
+_COST_ERROR_KEYS: set = set()
+_COST_ERROR_WARNED = False
 # memoized everything-replicated cost estimates keyed by program key — the
 # audit baseline: "what would this program cost per host if nothing were
 # sharded" (heat_tpu/analysis/audit.py divides by the mesh size to get the
@@ -537,6 +562,14 @@ def _node_nbytes(node: LazyArray) -> int:
     return size * np.dtype(node.dtype).itemsize
 
 
+#: pending-node ids of the signature currently held at the admission gate:
+#: while the drain policy recursively forces OTHER roots, neither the drain
+#: loop nor those forces' own _gather_batch may touch any node of the gated
+#: chain — batching it would dispatch the chain a second time when admit()
+#: returns and the original force runs its already-built program
+_DRAIN_EXCLUDE: frozenset = frozenset()
+
+
 def _gather_batch(entries, leaves, memo, roots):
     """Select other live pending roots to dispatch alongside the triggering
     root, in stable registration order (nondeterministic ordering would
@@ -573,6 +606,8 @@ def _gather_batch(entries, leaves, memo, roots):
             continue
         if id(payload) in memo:
             continue  # interior to (or already selected by) this batch
+        if _DRAIN_EXCLUDE and id(payload) in _DRAIN_EXCLUDE:
+            continue  # part of the chain held at the admission gate
         if _node_nbytes(payload) > _BATCH_BYTES:
             continue
         if getattr(wrapper.comm, "device_set", None) != device_set:
@@ -581,6 +616,66 @@ def _gather_batch(entries, leaves, memo, roots):
         roots.append(payload)
     for key in stale:
         _LIVE_ROOTS.pop(key, None)
+
+
+def _static_peak(key: str, leaves, roots) -> Tuple[int, str]:
+    """The failing/candidate program's static per-host memory peak for the
+    admission gate and OOM forensics: XLA's memoized ``memory_analysis``
+    peak when :func:`program_costs` has computed it (``"static"``), else the
+    cheap operand+result estimate (``"estimate"``) — the gate must never
+    compile at dispatch time."""
+    cost = _COSTS.get(key)
+    if cost:
+        peak = (cost.get("memory") or {}).get("peak_bytes")
+        if peak:
+            return int(peak), "static"
+    est = 0
+    for leaf in leaves:
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is not None:
+            est += int(nbytes)
+    for r in roots:
+        est += _node_nbytes(r)
+    return est, "estimate"
+
+
+def _drain_pending_roots(exclude=()):
+    """The ``drain`` admission policy's arm: force every OTHER live pending
+    root and block until its value is on device — outstanding async futures
+    stop being "outstanding", and their operand chains become collectable.
+    ``exclude`` holds the ids of EVERY pending node of the gated signature
+    (roots and interior nodes); it is also published as ``_DRAIN_EXCLUDE``
+    so the recursive forces' own ``_gather_batch`` cannot pull the gated
+    chain into another root's program — that would dispatch the chain twice
+    once the gate admits the original force. Returns how many roots were
+    drained; counted as ``drain`` blocking syncs so the async-forcing
+    report shows what the gate cost."""
+    global _DRAIN_EXCLUDE
+    prev, _DRAIN_EXCLUDE = _DRAIN_EXCLUDE, _DRAIN_EXCLUDE | frozenset(exclude)
+    drained = 0
+    try:
+        for key in sorted(_LIVE_ROOTS.keys()):
+            wrapper = _LIVE_ROOTS.get(key)
+            if wrapper is None:
+                continue
+            payload = wrapper._payload
+            if not isinstance(payload, LazyArray) or id(payload) in _DRAIN_EXCLUDE:
+                continue
+            if payload._value is None:
+                force(payload)
+            value = payload._value
+            if isinstance(value, jax.Array):
+                token = None
+                if telemetry._MODE:
+                    token = telemetry.record_blocking_sync("drain", cid=payload.cid)
+                value.block_until_ready()
+                # close the event so the trace shows the drain's true host
+                # wait as a duration, not a zero-width instant
+                telemetry.end_blocking_sync(token)
+                drained += 1
+    finally:
+        _DRAIN_EXCLUDE = prev
+    return drained
 
 
 def _quarantine(sig) -> None:
@@ -693,26 +788,51 @@ def force(node):
             telemetry.record_force(
                 telemetry.current_trigger(), node.depth, compiled=missed, cid=node.cid
             )
+        if memledger._BUDGET_RAW is not None:
+            # headroom admission gate (core/memledger.py): live ledger bytes
+            # + this program's static peak against HEAT_TPU_MEMORY_BUDGET.
+            # Sits BEFORE the guarded try, so the `raise` policy surfaces to
+            # the caller with the chain intact instead of degrading to an
+            # eager replay that would dispatch the same bytes anyway.
+            peak, peak_src = _static_peak(info["key"], leaves, roots)
+            # every pending node of THIS signature (roots + interior): the
+            # drain policy must not let another force's batch absorb any of
+            # them — the program below is already built over this walk
+            exclude = frozenset(memo)
+            memledger.admit(
+                info["key"], info["family"], peak, peak_src,
+                drain_fn=lambda: _drain_pending_roots(exclude),
+            )
+            if node._value is not None:  # pragma: no cover - belt and braces
+                # some recursive path materialized this very chain while the
+                # gate held it: the dispatch is done, do not run it again
+                return node._value
         try:
             if resilience._ARMED:
                 # jax.jit builds lazily, so the XLA compile happens inside the
                 # first call — the injection sites model that split
                 resilience.check("fusion.compile" if missed else "fusion.execute")
+                # device OOM at dispatch time, as an injectable failure mode
+                # (ISSUE 8): fires the same seam a real RESOURCE_EXHAUSTED
+                # would, so the forensic + degrade path is testable
+                resilience.check("memory.exhausted")
             values = prog(*leaves)
             info["dispatches"] += 1
             info["roots"] += len(roots)
         except Exception as exc:  # noqa: BLE001 - routed through ONE policy
+            if memledger.is_oom(exc):
+                # forensics BEFORE the degrade: rank the live buffers by
+                # owner and name the failing program while the evidence is
+                # still live — the eager replay below will churn it
+                peak, _src = _static_peak(info["key"], leaves, roots)
+                memledger.record_oom(
+                    exc, program=info["key"], family=info["family"],
+                    static_peak=peak,
+                )
             if not resilience.force_recoverable(exc):
                 raise
             values = _degrade(sig, leaves, exc, missed)
             info = None  # the eager replay is not a program dispatch
-    if telemetry._MODE:
-        telemetry.record_async_dispatch(
-            len(roots),
-            cid=node.cid,
-            cids=[r.cid for r in roots],
-            program=None if info is None else info["key"],
-        )
     # under an enclosing trace the jit bind joins that trace and the values
     # are tracers even though every leaf is concrete (verified on jax
     # 0.4.37); caching is gated on each value's actual concreteness, not
@@ -726,6 +846,18 @@ def force(node):
             # node as a leaf, and the chain's operand buffers become
             # collectable
             root.children = ()
+            # ledger attribution: a dispatched-but-unclaimed async future is
+            # "fusion" until a wrapper claims it at the parray seam. Tagged
+            # BEFORE the dispatch event below, whose ledger sample must see
+            # the in-flight futures attributed, not "unattributed"
+            memledger.tag(value, "fusion")
+    if telemetry._MODE:
+        telemetry.record_async_dispatch(
+            len(roots),
+            cid=node.cid,
+            cids=[r.cid for r in roots],
+            program=None if info is None else info["key"],
+        )
     return values[0]
 
 
@@ -762,6 +894,7 @@ def clear_cache() -> None:
     _PROGRAM_INFO.clear()
     _COSTS.clear()
     _REPL_COSTS.clear()
+    _COST_ERROR_KEYS.clear()  # the once-per-session warn flag survives
     _QUARANTINE.clear()
     _LIVE_ROOTS.clear()
     _STATS.update(
@@ -1248,6 +1381,28 @@ def _estimate_cost(sig, replicated: bool = False) -> dict:
         return cost
     try:
         compiled = jax.jit(_build(sig)).lower(*specs).compile()
+        try:
+            # XLA's post-compile memory accounting: the static per-host
+            # peak (arguments + outputs + temps) the admission gate and
+            # the resplit O(n/p) assertion surface read. Best-effort —
+            # some backends return None or omit the analysis entirely.
+            ma = compiled.memory_analysis()
+            if ma is not None:
+                arg_b = int(getattr(ma, "argument_size_in_bytes", 0))
+                out_b = int(getattr(ma, "output_size_in_bytes", 0))
+                tmp_b = int(getattr(ma, "temp_size_in_bytes", 0))
+                cost["memory"] = {
+                    "argument_bytes": arg_b,
+                    "output_bytes": out_b,
+                    "temp_bytes": tmp_b,
+                    "alias_bytes": int(getattr(ma, "alias_size_in_bytes", 0)),
+                    "generated_code_bytes": int(
+                        getattr(ma, "generated_code_size_in_bytes", 0)
+                    ),
+                    "peak_bytes": arg_b + out_b + tmp_b,
+                }
+        except (AttributeError, RuntimeError, TypeError, ValueError):
+            pass  # no memory analysis on this backend: flops/HLO still bank
         hlo_text = compiled.as_text()
         entries = telemetry.hlo_collectives(hlo_text)
         cost["collectives"] = {}
@@ -1269,12 +1424,45 @@ def _estimate_cost(sig, replicated: bool = False) -> dict:
     return cost
 
 
+def _note_cost_error(key: str, cost: dict) -> None:
+    """Count a failed cost estimate (``cost["error"]``) into the per-key
+    error ledger and warn once per session — a backend that cannot analyze
+    programs must be visible, not silently averaged away."""
+    global _COST_ERROR_WARNED
+    if "error" not in cost:
+        _COST_ERROR_KEYS.discard(key)
+        return
+    _COST_ERROR_KEYS.add(key)
+    if not _COST_ERROR_WARNED:
+        _COST_ERROR_WARNED = True
+        import warnings
+
+        warnings.warn(
+            ProgramCostWarning(
+                f"cost estimate failed for cached program {key} "
+                f"({cost['error']}); further failures are counted into "
+                "report()['programs']['cost_errors'] without re-warning"
+            ),
+            stacklevel=4,
+        )
+
+
+def cost_error_count() -> int:
+    """How many cached programs currently hold a failed cost estimate
+    (``report()["programs"]["cost_errors"]``)."""
+    return len(_COST_ERROR_KEYS)
+
+
 def program_costs(top: Optional[int] = None, refresh: bool = False) -> dict:
     """Cost estimates for the cached sharded programs, keyed by program key
     and ranked by dispatch count (``top`` limits how many are analyzed).
     Estimates come from :func:`_estimate_cost` and are memoized per key
     (``refresh=True`` recomputes); each entry also carries the program's
-    ``family`` and ``dispatches`` so flops×dispatches ranks total spend.
+    ``family`` and ``dispatches`` so flops×dispatches ranks total spend,
+    and (where the backend exposes ``memory_analysis``) a ``memory`` block
+    with the static argument/output/temp/peak bytes per host. Backend
+    estimate failures are never silent: they count into
+    :func:`cost_error_count` and warn once per session.
     Never touches live data or forces a pending chain."""
     ranked = sorted(
         _PROGRAM_INFO.items(), key=lambda kv: kv[1]["dispatches"], reverse=True
@@ -1287,6 +1475,7 @@ def program_costs(top: Optional[int] = None, refresh: bool = False) -> dict:
         cost = None if refresh else _COSTS.get(key)
         if cost is None:
             cost = _COSTS[key] = _estimate_cost(sig)
+            _note_cost_error(key, cost)
         public = {k: v for k, v in cost.items() if k != "collective_lines"}
         out[key] = dict(
             public, family=info["family"], dispatches=info["dispatches"]
@@ -1315,6 +1504,7 @@ def program_audit_info(top: Optional[int] = None, refresh: bool = False) -> dict
         cost = None if refresh else _COSTS.get(key)
         if cost is None:
             cost = _COSTS[key] = _estimate_cost(sig)
+            _note_cost_error(key, cost)
         rcost = None if refresh else _REPL_COSTS.get(key)
         if rcost is None:
             rcost = _REPL_COSTS[key] = _estimate_cost(sig, replicated=True)

@@ -40,7 +40,7 @@ from typing import List, Optional, Tuple, Union
 import numpy as np
 
 from . import devices as devices_module
-from . import factories, resilience, telemetry, types
+from . import factories, memledger, resilience, telemetry, types
 from .communication import sanitize_comm
 from .dndarray import DNDarray
 
@@ -189,7 +189,13 @@ def _sharded_ingest(read_block, gshape, dtype, split, device, comm) -> DNDarray:
             widths = [(0, 0)] * len(gshape)
             widths[split] = (0, block - counts[r])
             local = np.pad(local, widths)
-        arrays.append(jax.device_put(local, d))
+        piece = jax.device_put(local, d)
+        # ledger attribution for the staging pieces: "io" by default,
+        # "checkpoint" when the restore path's owner_scope is active — a
+        # watermark sample taken mid-ingest names the subsystem holding
+        # the bytes, not "unattributed"
+        memledger.tag(piece, memledger.current_owner() or "io")
+        arrays.append(piece)
     if telemetry._MODE >= 2:
         # one timeline milestone per sharded ingest: block reads done, bytes
         # on host, about to stitch (the trace shows I/O next to the programs

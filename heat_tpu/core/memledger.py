@@ -1,0 +1,748 @@
+"""Memory observability: the live-buffer ledger, high watermark, headroom
+admission gate and OOM forensics.
+
+Heat's whole reason to exist is arrays that don't fit one host (the HeAT
+paper, arXiv:2007.13552, positions memory capacity — not flops — as the
+scaling wall for distributed data analytics), yet until this module the
+memory story was two best-effort snapshots folded into
+``telemetry.report()["memory"]``. This module makes memory a first-class
+observable with four connected surfaces:
+
+* **The live-buffer ledger** (:func:`ledger`) — every ``jax.live_arrays()``
+  buffer attributed to an *owner*: ``dndarray`` (payloads stored on
+  wrappers, tagged at construction and at the ``parray`` forcing seam),
+  ``fusion`` (dispatched-but-unclaimed async futures installed by
+  ``fusion.force``), ``checkpoint`` / ``io`` (staging and ingest arrays,
+  tagged via :func:`owner_scope`), and ``unattributed`` (foreign arrays the
+  user created directly with jax). Attribution rides a weakref registry
+  (:func:`tag`) — entries die with their arrays, id-reuse is guarded by
+  identity-checking the weakref — and buffers addressable from multiple
+  shards are deduped by (device, buffer pointer), so a replicated array
+  counts once per device buffer, never once per view.
+* **The high watermark** (:func:`watermark`) — the largest live total (and
+  its per-owner split) any :func:`sample` has seen. Samples are taken at the
+  dispatch/force/collective/checkpoint seams (``telemetry`` calls
+  :func:`note` from its record functions; the admission gate samples on
+  every check) and are *throttled* (``HEAT_TPU_MEMORY_SAMPLE_MS``, default
+  20 ms) so the hot path stays cheap; ``sample(force=True)`` bypasses the
+  throttle for tests and benches. In verbose telemetry each sample lands on
+  the trace timeline as a ``memory`` event, exported to Perfetto as per-host
+  counter ("C") tracks.
+* **The headroom admission gate** (:func:`admit`) — ``HEAT_TPU_MEMORY_BUDGET``
+  (absolute bytes, with ``KiB``/``MiB``/``GiB`` suffixes, or a 0<x<=1
+  fraction of device — falling back to host — memory) is checked at the
+  fused-program dispatch seam against *live ledger bytes + the program's
+  static peak* (XLA's ``memory_analysis()`` when the cost is memoized,
+  operand+result bytes otherwise). ``HEAT_TPU_MEMORY_POLICY`` picks what
+  happens on projected overrun: ``warn`` (once per program key), ``raise``
+  (:class:`MemoryBudgetExceeded` *before* the dispatch — the chain stays
+  pending and can be forced after the budget is lifted), or ``drain``
+  (blocking-sync every outstanding async root first, then re-check and warn
+  only if still over). This is the direct prework for ROADMAP 4's
+  token-bucket admission control, and the gauge that lets ROADMAP 3's
+  resplit rewrite assert O(n/p) peak.
+* **OOM forensics** (:func:`record_oom` / :func:`last_oom`) — when a fused
+  dispatch dies of ``RESOURCE_EXHAUSTED`` / ``XlaRuntimeError`` OOM /
+  ``MemoryError`` (injectable at the ``memory.exhausted`` fault site),
+  ``fusion.force`` produces a ranked diagnostic — top live buffers by
+  owner, the failing program's key and static peak, the last-N dispatches
+  from the trace timeline — as a :class:`MemoryExhaustedWarning` *before*
+  handing the chain to resilience's guarded degrade path, so the answer to
+  "what ate the HBM" survives the recovery.
+
+Everything here is observability: :func:`ledger`/:func:`sample` never force
+a pending chain (``jax.live_arrays`` holds only concrete buffers), never
+raise, and never initialize a backend (jax is imported lazily; the
+``telemetry.report()`` memory block additionally gates on the mesh
+singleton). The ledger attribution registry is always on (one dict store
+per payload store); sampling hooks obey :func:`set_enabled` /
+``HEAT_TPU_MEMORY_LEDGER=0``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import warnings
+import weakref
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import telemetry
+
+__all__ = [
+    "MemoryBudgetExceeded",
+    "MemoryBudgetWarning",
+    "MemoryExhaustedWarning",
+    "admit",
+    "budget_info",
+    "gate_stats",
+    "is_oom",
+    "last_oom",
+    "ledger",
+    "note",
+    "owner_scope",
+    "parse_budget",
+    "record_oom",
+    "reset",
+    "reset_watermark",
+    "sample",
+    "set_budget",
+    "set_enabled",
+    "tag",
+    "watermark",
+]
+
+_OFF_VALUES = ("", "0", "false", "off", "no")
+
+
+class MemoryBudgetExceeded(MemoryError):
+    """A dispatch was refused by the headroom admission gate
+    (``HEAT_TPU_MEMORY_POLICY=raise``): projected bytes (live ledger +
+    static program peak) exceed ``HEAT_TPU_MEMORY_BUDGET``. Raised *before*
+    the program runs — the pending chain is left intact and can be forced
+    once the budget is lifted or memory is freed."""
+
+
+class MemoryBudgetWarning(UserWarning):
+    """Projected bytes for a dispatch exceed the memory budget under the
+    ``warn`` policy (or still exceed it after a ``drain``). Warned once per
+    program key."""
+
+
+class MemoryExhaustedWarning(UserWarning):
+    """A fused dispatch died of device memory exhaustion; the warning
+    carries the ranked forensic diagnostic (:func:`last_oom` holds the
+    structured form) and the chain degrades to per-op eager replay."""
+
+
+# ----------------------------------------------------------------------
+# owner registry: id(arr) -> (weakref, owner)
+# ----------------------------------------------------------------------
+#: attribution registry. Keyed by id() with an identity-checked weakref (a
+#: recycled id can never inherit a dead array's owner); the weakref death
+#: callback removes the entry, so the registry never outlives its arrays.
+_REGISTRY: Dict[int, Tuple[Any, str]] = {}
+
+#: ambient owner for arrays tagged without an explicit owner (the
+#: checkpoint/io staging seams push scopes; innermost wins)
+_OWNER_STACK: List[str] = []
+
+#: default owner bucket for live buffers nobody tagged
+UNATTRIBUTED = "unattributed"
+
+
+def tag(arr, owner: Optional[str] = None) -> None:
+    """Attribute ``arr``'s buffers to ``owner`` (or the innermost
+    :func:`owner_scope`). The LAST tag wins — a fused async future re-tagged
+    at the ``parray`` seam moves from ``fusion`` to ``dndarray``. No-op for
+    non-weakref-able values (numpy arrays, scalars, tracers have no device
+    buffer to account)."""
+    if owner is None:
+        owner = _OWNER_STACK[-1] if _OWNER_STACK else UNATTRIBUTED
+    key = id(arr)
+    try:
+        ref = weakref.ref(arr, lambda r, key=key: _drop_entry(key, r))
+    except TypeError:  # not weakref-able: nothing device-side to track
+        return
+    _REGISTRY[key] = (ref, owner)
+
+
+def _drop_entry(key: int, ref) -> None:
+    cur = _REGISTRY.get(key)
+    if cur is not None and cur[0] is ref:
+        _REGISTRY.pop(key, None)
+
+
+def _owner_of(arr) -> str:
+    rec = _REGISTRY.get(id(arr))
+    if rec is not None and rec[0]() is arr:
+        return rec[1]
+    return UNATTRIBUTED
+
+
+@contextmanager
+def owner_scope(owner: str):
+    """Attribute every :func:`tag` without an explicit owner inside this
+    scope to ``owner`` — the seam ``utils/checkpoint.py`` (restore staging)
+    and ``core/io.py`` (sharded ingest) wrap their array-producing bodies
+    in, so transient staging buffers show up under their subsystem instead
+    of ``unattributed``. Scopes nest; the innermost wins."""
+    _OWNER_STACK.append(str(owner))
+    try:
+        yield
+    finally:
+        _OWNER_STACK.pop()
+
+
+def current_owner() -> Optional[str]:
+    """The innermost active :func:`owner_scope`, or None outside any."""
+    return _OWNER_STACK[-1] if _OWNER_STACK else None
+
+
+# ----------------------------------------------------------------------
+# the live-buffer walk (shared by ledger / sample / the gate)
+# ----------------------------------------------------------------------
+def _buffer_key(shard, arr, i):
+    """Dedupe key for one addressable shard: (device, buffer pointer) where
+    the backend exposes it, else (owning array id, shard index) — a buffer
+    addressable from multiple shards/views must count once."""
+    try:
+        return (str(shard.device), shard.data.unsafe_buffer_pointer())
+    except (AttributeError, RuntimeError, ValueError, NotImplementedError):
+        return (id(arr), i)
+
+
+def _scan(top: int = 0) -> Dict[str, Any]:
+    """One pass over ``jax.live_arrays()``: total bytes, per-owner bytes,
+    deduped buffer count, and (``top`` > 0) the largest buffers. Never
+    forces (live arrays are concrete), never raises past jax being absent,
+    and skips deleted/donated buffers without a blanket except (the deleted
+    race surfaces as ``RuntimeError`` from the shards read)."""
+    out: Dict[str, Any] = {"total_bytes": 0, "by_owner": {}, "buffers": 0, "top": []}
+    try:
+        import jax
+
+        arrays = jax.live_arrays()
+    except Exception:  # pragma: no cover - no backend at all
+        return out
+    by_owner = out["by_owner"]
+    seen = set()
+    largest: List[Tuple[int, str, tuple, str]] = []
+    # attributed arrays claim their buffers FIRST: jax tracks a global
+    # sharded array AND its per-shard children as separate live arrays over
+    # the same device buffers, so the dedupe pass must let the tagged owner
+    # win regardless of live_arrays() enumeration order
+    ranked = sorted(arrays, key=lambda arr: _owner_of(arr) == UNATTRIBUTED)
+    for arr in ranked:
+        try:
+            if arr.is_deleted():
+                continue
+            shards = arr.addressable_shards
+        except RuntimeError:  # deleted/donated between the check and the read
+            continue
+        owner = _owner_of(arr)
+        arr_bytes = 0
+        for i, s in enumerate(shards):
+            key = _buffer_key(s, arr, i)
+            if key in seen:
+                continue
+            seen.add(key)
+            try:
+                nbytes = int(s.data.nbytes)
+            except RuntimeError:  # deleted mid-walk
+                continue
+            arr_bytes += nbytes
+            out["buffers"] += 1
+        if not arr_bytes:
+            continue
+        out["total_bytes"] += arr_bytes
+        by_owner[owner] = by_owner.get(owner, 0) + arr_bytes
+        if top:
+            largest.append(
+                (arr_bytes, owner, tuple(int(d) for d in arr.shape), str(arr.dtype))
+            )
+    if top:
+        largest.sort(key=lambda t: -t[0])
+        out["top"] = [
+            {"nbytes": n, "owner": o, "shape": list(sh), "dtype": dt}
+            for n, o, sh, dt in largest[:top]
+        ]
+    return out
+
+
+def _scan_total() -> int:
+    """Deduped live bytes only — no owner attribution, no sorting, no top-K.
+    The admission gate's per-dispatch fast path: the within-budget decision
+    needs one number, and the O(n log n) attributed walk would otherwise
+    ride every armed dispatch (attribution is computed lazily, only on the
+    over-budget path)."""
+    try:
+        import jax
+
+        arrays = jax.live_arrays()
+    except Exception:  # pragma: no cover - no backend at all
+        return 0
+    seen = set()
+    total = 0
+    for arr in arrays:
+        try:
+            if arr.is_deleted():
+                continue
+            shards = arr.addressable_shards
+        except RuntimeError:  # deleted/donated between the check and the read
+            continue
+        for i, s in enumerate(shards):
+            key = _buffer_key(s, arr, i)
+            if key in seen:
+                continue
+            seen.add(key)
+            try:
+                total += int(s.data.nbytes)
+            except RuntimeError:  # deleted mid-walk
+                continue
+    return total
+
+
+def ledger(top: int = 5) -> Dict[str, Any]:
+    """The owner-attributed live-buffer ledger: ``total_bytes``, per-owner
+    ``by_owner`` bytes, the deduped ``buffers`` count and the ``top``-K
+    largest buffers (owner/shape/dtype/bytes). Read-only and force-free —
+    safe to call with chains pending."""
+    return _scan(top=max(0, int(top)))
+
+
+# ----------------------------------------------------------------------
+# sampling + the high watermark
+# ----------------------------------------------------------------------
+_ENABLED = os.environ.get("HEAT_TPU_MEMORY_LEDGER", "1").strip().lower() not in _OFF_VALUES
+_SAMPLE_EVERY_S = max(0.0, float(os.environ.get("HEAT_TPU_MEMORY_SAMPLE_MS", "20"))) / 1e3
+_LAST_SAMPLE_TS = 0.0
+
+_WATERMARK: Dict[str, Any] = {"bytes": 0, "by_owner": {}, "event": None, "samples": 0}
+
+
+def set_enabled(flag: bool) -> bool:
+    """Flip the sampling hooks in-process (``HEAT_TPU_MEMORY_LEDGER`` env
+    knob at import); returns the previous state. Attribution tagging and the
+    on-demand :func:`ledger` stay available either way."""
+    global _ENABLED
+    prev, _ENABLED = _ENABLED, bool(flag)
+    return prev
+
+
+def sample(event: str = "manual", force: bool = False) -> Optional[Dict[str, Any]]:
+    """Take one ledger sample, update the high watermark, and (verbose
+    telemetry) emit a ``memory`` timeline event the Perfetto exporter
+    renders as counter tracks. Throttled to one sample per
+    ``HEAT_TPU_MEMORY_SAMPLE_MS`` unless ``force=True``; returns the
+    snapshot taken, or None when throttled/disabled.
+
+    Cost discipline: the hook path (``note`` from the telemetry record
+    seams, mode <= 1) pays only the deduped total — the attributed
+    sort-walk runs when a new peak must bank its owner split, when the
+    caller forced the sample, or in verbose mode (the exported counter
+    tracks carry the per-owner series). The telemetry overhead guard
+    (enabled dispatch rate >= 0.9x disabled) stays green with the hooks on."""
+    global _LAST_SAMPLE_TS
+    if not force:
+        if not _ENABLED:
+            return None
+        now = time.perf_counter()
+        if now - _LAST_SAMPLE_TS < _SAMPLE_EVERY_S:
+            return None
+    verbose = telemetry._MODE >= 2
+    snap = _scan() if (force or verbose) else None
+    total = snap["total_bytes"] if snap is not None else _scan_total()
+    _LAST_SAMPLE_TS = time.perf_counter()
+    _WATERMARK["samples"] += 1
+    if total > _WATERMARK["bytes"]:
+        if snap is None:
+            snap = _scan()  # a new peak banks its owner split
+        _WATERMARK["bytes"] = max(total, snap["total_bytes"])
+        _WATERMARK["by_owner"] = dict(snap["by_owner"])
+        _WATERMARK["event"] = event
+    if verbose and snap is not None:
+        telemetry.record_event(
+            "memory",
+            event=event,
+            total=snap["total_bytes"],
+            by_owner=dict(snap["by_owner"]),
+            watermark=_WATERMARK["bytes"],
+        )
+    if snap is not None:
+        return snap
+    return {"total_bytes": total, "by_owner": {}, "buffers": 0, "top": []}
+
+
+def note(event: str) -> None:
+    """The hot-path sampling hook (telemetry's record functions and the
+    admission gate call it at the dispatch/force/collective/checkpoint
+    seams). One attribute read when disabled; throttled otherwise."""
+    if _ENABLED:
+        sample(event)
+
+
+def watermark() -> Dict[str, Any]:
+    """The high watermark: the largest sampled live total (``bytes``), its
+    per-owner split, the event kind that set it, and how many samples have
+    been taken. Pure state — never touches jax."""
+    return {
+        "bytes": _WATERMARK["bytes"],
+        "by_owner": dict(_WATERMARK["by_owner"]),
+        "event": _WATERMARK["event"],
+        "samples": _WATERMARK["samples"],
+    }
+
+
+def reset_watermark() -> None:
+    """Zero the watermark (benches bracket a measured region with this)."""
+    _WATERMARK.update(bytes=0, by_owner={}, event=None, samples=0)
+
+
+# ----------------------------------------------------------------------
+# the headroom admission gate
+# ----------------------------------------------------------------------
+_UNITS = {
+    "b": 1,
+    "kb": 10**3, "mb": 10**6, "gb": 10**9, "tb": 10**12,
+    "kib": 1 << 10, "mib": 1 << 20, "gib": 1 << 30, "tib": 1 << 40,
+    # bare single letters read as binary — "2G" means memory, not disk ads
+    "k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40,
+}
+
+
+def parse_budget(value) -> Optional[object]:
+    """Parse a budget spec: ``None``/off-words disarm; an int (or suffixed
+    string like ``"512MiB"``) is absolute bytes; a float in (0, 1] is a
+    fraction of device (else host) memory, resolved lazily at the first
+    gate check. Returns int bytes, float fraction, or None."""
+    if value is None:
+        return None
+    if isinstance(value, bool):
+        raise ValueError("memory budget must be bytes or a fraction, not a bool")
+    if isinstance(value, (int, float)):
+        if isinstance(value, float) and 0.0 < value <= 1.0:
+            return float(value)
+        if value <= 0:
+            return None
+        return int(value)
+    text = str(value).strip().lower()
+    if text in _OFF_VALUES:
+        return None
+    for unit in sorted(_UNITS, key=len, reverse=True):
+        if text.endswith(unit) and text[: -len(unit)].strip():
+            return int(float(text[: -len(unit)].strip()) * _UNITS[unit])
+    num = float(text)
+    if 0.0 < num <= 1.0:
+        return num
+    if num <= 0:
+        return None
+    return int(num)
+
+
+_POLICIES = ("warn", "raise", "drain")
+
+def _parse_env_budget(value) -> Optional[object]:
+    """The env-knob form of :func:`parse_budget`: a malformed value warns
+    and disarms instead of making ``import heat_tpu`` raise — the same
+    typo-must-not-take-the-process-down contract as the policy knob."""
+    try:
+        return parse_budget(value)
+    except (ValueError, TypeError):
+        warnings.warn(
+            f"HEAT_TPU_MEMORY_BUDGET={value!r} is not parseable (bytes, a "
+            "KiB/MiB/GiB-suffixed string, or a 0-1 fraction); the admission "
+            "gate stays disarmed",
+            stacklevel=1,
+        )
+        return None
+
+
+#: the armed budget (int bytes / float fraction / None) — module attribute
+#: so the dispatch hot path gates with one attribute read when disarmed
+_BUDGET_RAW = _parse_env_budget(os.environ.get("HEAT_TPU_MEMORY_BUDGET"))
+_POLICY = os.environ.get("HEAT_TPU_MEMORY_POLICY", "warn").strip().lower() or "warn"
+if _POLICY not in _POLICIES:  # a typo'd env knob must not take the process down
+    warnings.warn(
+        f"HEAT_TPU_MEMORY_POLICY={_POLICY!r} is not one of {_POLICIES}; using 'warn'",
+        stacklevel=1,
+    )
+    _POLICY = "warn"
+
+#: lazily-resolved absolute budget for fractional specs (device memory where
+#: the backend exposes bytes_limit, host physical memory otherwise)
+_RESOLVED_BUDGET: Optional[int] = None
+
+_GATE_STATS = {
+    "checks": 0, "allowed": 0, "exceeded": 0,
+    "drains": 0, "drained_roots": 0, "warned": 0, "raised": 0,
+}
+_WARNED_KEYS: set = set()
+
+#: reentrancy guard: a drain forces other pending roots, whose forces must
+#: not re-enter the gate (they are the freeing, not new admissions)
+_IN_GATE = False
+
+
+def set_budget(budget=None, policy: Optional[str] = None):
+    """(Re)arm the admission gate in-process: ``budget`` as
+    :func:`parse_budget` accepts (None disarms), ``policy`` one of
+    ``warn``/``raise``/``drain``. Returns the previous ``(budget, policy)``
+    pair. Re-arming clears the once-per-key warn ledger and the resolved
+    fractional budget."""
+    global _BUDGET_RAW, _POLICY, _RESOLVED_BUDGET
+    prev = (_BUDGET_RAW, _POLICY)
+    _BUDGET_RAW = parse_budget(budget)
+    if policy is not None:
+        if policy not in _POLICIES:
+            raise ValueError(f"policy must be one of {_POLICIES}, got {policy!r}")
+        _POLICY = policy
+    _RESOLVED_BUDGET = None
+    _WARNED_KEYS.clear()
+    return prev
+
+
+def _device_bytes_limit() -> Optional[int]:
+    """Per-host accountable device memory: the min bytes_limit over local
+    devices x their count, where the backend exposes memory_stats (TPU
+    does; forced-host CPU does not)."""
+    try:
+        import jax
+
+        limits = []
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if stats and stats.get("bytes_limit"):
+                limits.append(int(stats["bytes_limit"]))
+        if limits:
+            return min(limits) * len(limits)
+    except Exception:  # noqa: BLE001 - backend-dependent probe only
+        pass
+    return None
+
+
+def _host_bytes_total() -> Optional[int]:
+    try:
+        return int(os.sysconf("SC_PAGE_SIZE")) * int(os.sysconf("SC_PHYS_PAGES"))
+    except (ValueError, OSError, AttributeError):  # pragma: no cover - non-POSIX
+        return None
+
+
+def _resolve_budget() -> Optional[int]:
+    """The absolute byte budget: fractions resolve against device memory
+    where the backend reports a limit, host physical memory otherwise
+    (forced-host CPU meshes — the dev config); memoized."""
+    global _RESOLVED_BUDGET
+    if _BUDGET_RAW is None:
+        return None
+    if isinstance(_BUDGET_RAW, int):
+        return _BUDGET_RAW
+    if _RESOLVED_BUDGET is None:
+        base = _device_bytes_limit() or _host_bytes_total()
+        if base is None:
+            return None  # nothing to take a fraction of: gate stays open
+        _RESOLVED_BUDGET = int(_BUDGET_RAW * base)
+    return _RESOLVED_BUDGET
+
+
+def budget_info(resolve: bool = False) -> Dict[str, Any]:
+    """The gate's configuration + counters: the raw knob, the resolved byte
+    budget (None = disarmed/unresolved), the policy, and
+    :func:`gate_stats`. A fractional budget is only resolved on demand
+    (``resolve=True``) or once a gate check already resolved it — resolving
+    probes the backend's device memory, and this function is called from
+    ``telemetry.report()``, which must never initialize the backend."""
+    if _BUDGET_RAW is None:
+        budget_bytes = None
+    elif isinstance(_BUDGET_RAW, int):
+        budget_bytes = _BUDGET_RAW
+    elif resolve or _RESOLVED_BUDGET is not None:
+        budget_bytes = _resolve_budget()
+    else:
+        budget_bytes = None  # fraction, not yet resolved: stay backend-free
+    return {
+        "budget": _BUDGET_RAW,
+        "budget_bytes": budget_bytes,
+        "policy": _POLICY,
+        **gate_stats(),
+    }
+
+
+def gate_stats() -> Dict[str, int]:
+    """Admission-gate counters: ``checks``/``allowed``/``exceeded`` plus the
+    per-policy outcomes (``warned``/``raised``/``drains``/``drained_roots``)
+    — the assertable surface the budget-policy tests pin."""
+    return dict(_GATE_STATS)
+
+
+def admit(program: str, family: str, static_peak: int, source: str, drain_fn=None) -> None:
+    """The headroom check at the fused-program dispatch seam: projected
+    bytes = live ledger total + ``static_peak`` (the program's memoized XLA
+    ``memory_analysis`` peak when available — ``source="static"`` — else the
+    operand+result estimate). Within budget: returns. Over budget: applies
+    the armed policy (see module docstring). Reentrant drains are admitted
+    unconditionally — they free memory, they don't claim it."""
+    global _IN_GATE
+    if _BUDGET_RAW is None or _IN_GATE:
+        return
+    budget = _resolve_budget()
+    if budget is None:
+        return
+    _GATE_STATS["checks"] += 1
+    # fast path: one deduped total, no attribution, no sort — the per-
+    # dispatch cost of an armed gate. The full attributed sample runs only
+    # when this total sets a new watermark (banking the owner split at the
+    # peak) or on the over-budget path (the warning names owners).
+    live = _scan_total()
+    if live > _WATERMARK["bytes"]:
+        sample("gate", force=True)
+    projected = live + int(static_peak)
+    if projected <= budget:
+        _GATE_STATS["allowed"] += 1
+        return
+    _GATE_STATS["exceeded"] += 1
+    policy = _POLICY
+    drained = None
+    if policy == "drain" and drain_fn is not None:
+        _GATE_STATS["drains"] += 1
+        _IN_GATE = True
+        try:
+            drained = int(drain_fn() or 0)
+        finally:
+            _IN_GATE = False
+        _GATE_STATS["drained_roots"] += drained
+        live = _scan_total()
+        projected = live + int(static_peak)
+    if telemetry._MODE >= 2:
+        telemetry.record_event(
+            "memory_gate",
+            program=program, policy=policy, projected=projected,
+            live=live, static_peak=int(static_peak), budget=budget,
+            drained=drained, over=projected > budget,
+        )
+    if projected <= budget:
+        _GATE_STATS["allowed"] += 1
+        return
+    if policy != "raise" and program in _WARNED_KEYS:
+        # steady over-budget state, already warned for this key: nothing
+        # will be emitted, so skip the attributed scan entirely
+        return
+    # the owners ranking is only paid when a warning/raise actually fires
+    snap = _scan()
+    owners = ", ".join(
+        f"{o} {_fmt_bytes(b)}"
+        for o, b in sorted(snap["by_owner"].items(), key=lambda kv: -kv[1])[:4]
+    )
+    msg = (
+        f"memory budget {_fmt_bytes(budget)} exceeded: projected "
+        f"{_fmt_bytes(projected)} (live {_fmt_bytes(live)} + static peak "
+        f"{_fmt_bytes(static_peak)} [{source}]) for program {program} "
+        f"({family}); top live owners: {owners or 'none'}"
+    )
+    if policy == "raise":
+        _GATE_STATS["raised"] += 1
+        raise MemoryBudgetExceeded(
+            msg + " — the chain is left pending; lift the budget "
+            "(memledger.set_budget) or free buffers, then force again"
+        )
+    if program not in _WARNED_KEYS:
+        _WARNED_KEYS.add(program)
+        _GATE_STATS["warned"] += 1
+        suffix = (
+            f" — drained {drained} outstanding root(s), still over budget"
+            if policy == "drain"
+            else ""
+        )
+        warnings.warn(MemoryBudgetWarning(msg + suffix), stacklevel=5)
+
+
+# ----------------------------------------------------------------------
+# OOM forensics
+# ----------------------------------------------------------------------
+_OOM_MARKERS = ("resource_exhausted", "resource exhausted", "out of memory", "memory.exhausted")
+
+_LAST_OOM: Optional[Dict[str, Any]] = None
+
+
+def is_oom(exc: BaseException) -> bool:
+    """Whether ``exc`` is device memory exhaustion: ``MemoryError``, an
+    ``XlaRuntimeError``/``RESOURCE_EXHAUSTED``-shaped backend error, or an
+    injected ``memory.exhausted`` fault (its message carries the site)."""
+    if isinstance(exc, MemoryError):
+        return True
+    text = (type(exc).__name__ + ": " + str(exc)).lower()
+    return any(marker in text for marker in _OOM_MARKERS)
+
+
+def record_oom(
+    exc: BaseException,
+    program: Optional[str] = None,
+    family: Optional[str] = None,
+    static_peak: Optional[int] = None,
+    top: int = 5,
+) -> Dict[str, Any]:
+    """Build, store and warn the ranked OOM diagnostic for a dispatch that
+    died of memory exhaustion: the failing program's key/family/static peak,
+    the owner-attributed ledger with the top live buffers, the last-N
+    ``dispatch`` events from the trace timeline (verbose mode), and the
+    gate configuration. Called by ``fusion.force`` *before* the guarded
+    degrade path so the evidence survives the recovery; returns the report
+    (also via :func:`last_oom`)."""
+    global _LAST_OOM
+    led = ledger(top=top)
+    recent = [
+        {"program": ev.get("program"), "roots": ev.get("roots"), "ts": ev.get("ts")}
+        for ev in telemetry.events()
+        if ev.get("kind") == "dispatch"
+    ][-5:]
+    report = {
+        "error": repr(exc),
+        "program": program,
+        "family": family,
+        "static_peak_bytes": None if static_peak is None else int(static_peak),
+        "live_total_bytes": led["total_bytes"],
+        "by_owner": dict(led["by_owner"]),
+        "top_buffers": list(led["top"]),
+        "recent_dispatches": recent,
+        "watermark_bytes": _WATERMARK["bytes"],
+        "budget": budget_info(),
+    }
+    _LAST_OOM = report
+    if telemetry._MODE:
+        telemetry.record_event("memory_oom", program=program, family=family,
+                               error=repr(exc), live=led["total_bytes"])
+    owners = ", ".join(
+        f"{o} {_fmt_bytes(b)}"
+        for o, b in sorted(led["by_owner"].items(), key=lambda kv: -kv[1])[:4]
+    )
+    tops = "; ".join(
+        f"{_fmt_bytes(b['nbytes'])} {b['owner']} {b['dtype']}{b['shape']}"
+        for b in led["top"][:3]
+    )
+    peak = "unknown" if static_peak is None else _fmt_bytes(static_peak)
+    warnings.warn(
+        MemoryExhaustedWarning(
+            f"device memory exhausted dispatching program {program or '<eager>'} "
+            f"({family or '?'}; static peak {peak}): {exc!r}. Live buffers "
+            f"{_fmt_bytes(led['total_bytes'])} by owner: {owners or 'none'}. "
+            f"Largest: {tops or 'none'}. Full diagnostic via "
+            "memledger.last_oom(); the chain degrades to per-op eager replay"
+        ),
+        stacklevel=5,
+    )
+    return report
+
+
+def last_oom() -> Optional[Dict[str, Any]]:
+    """The most recent OOM forensic report (None = no OOM seen)."""
+    return _LAST_OOM
+
+
+def _fmt_bytes(n) -> str:
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return str(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{int(n)} B" if unit == "B" else f"{n:.1f} {unit}"
+        n /= 1024.0
+    return f"{n:.1f} TiB"  # pragma: no cover - loop always returns
+
+
+def reset() -> None:
+    """Zero the watermark, gate counters, warn ledger and the stored OOM
+    report (the attribution registry stays — it tracks live arrays, not
+    session state)."""
+    global _LAST_OOM
+    reset_watermark()
+    for k in _GATE_STATS:
+        _GATE_STATS[k] = 0
+    _WARNED_KEYS.clear()
+    _LAST_OOM = None
+
+
+# register the sampling hook with telemetry (set-attribute, not import:
+# telemetry must stay importable before this module)
+telemetry._MEM_HOOK = note

@@ -27,6 +27,10 @@ site                  where it fires
 ``fusion.record``     recording an op into the expression DAG
 ``fusion.compile``    first execution (= XLA build) of a fused program
 ``fusion.execute``    every execution of an already-cached fused program
+``memory.exhausted``  the fused-program dispatch seam, modelling device OOM
+                      (``RESOURCE_EXHAUSTED``) at execute time — fires the
+                      OOM forensics (``core/memledger.py``) before the
+                      guarded degrade path absorbs the failure
 ``io.read``           each per-device block read of the sharded ingest
 ``io.write``          each (whole-file) write attempt of a ``save_*``
 ``io.rename``         the temp-then-rename publication step
@@ -390,9 +394,15 @@ def force_recoverable(exc: BaseException) -> bool:
     """Whether a fused-program build/execute failure should degrade the
     chain to per-op eager dispatch. Everything a compile/runtime can throw —
     including ``MemoryError`` (OOM compiles are exactly the TPU failure mode
-    worth surviving) — degrades; only our own numeric-policy signal
-    propagates, since it is raised *by* the forcing point, not by XLA."""
-    return not isinstance(exc, NonFiniteError)
+    worth surviving) — degrades; only our own policy signals propagate,
+    since they are raised *by* the forcing point, not by XLA: the errstate
+    non-finite error and the memory admission gate's refusal (which fires
+    before the dispatch precisely so the pending chain stays intact)."""
+    if isinstance(exc, NonFiniteError):
+        return False
+    from .memledger import MemoryBudgetExceeded
+
+    return not isinstance(exc, MemoryBudgetExceeded)
 
 
 # ----------------------------------------------------------------------

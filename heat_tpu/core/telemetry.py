@@ -42,7 +42,11 @@ The trace timeline
 events (:func:`events`): ``record`` / ``compile`` / ``dispatch`` /
 ``blocking_sync`` / ``collective`` / ``fused_collective`` / ``force`` /
 ``degraded`` / ``fault`` / ``io_retry`` / ``io`` / ``checkpoint`` /
-``checkpoint_phase`` / ``timer`` / ``span_begin`` / ``span_end``. Events of
+``checkpoint_phase`` / ``timer`` / ``span_begin`` / ``span_end`` /
+``memory`` / ``memory_gate`` / ``memory_oom`` (the live-buffer ledger's
+samples, gate decisions and OOM forensics — ``core/memledger.py``; the
+exporter renders ``memory`` samples as per-host Perfetto counter tracks).
+Events of
 one fused chain's lifecycle share a **correlation id** (``cid``, assigned at
 record time by ``core/fusion.py`` and inherited along the chain): the
 ``dispatch`` event lists every batched root's cid plus the sharded-program
@@ -75,11 +79,13 @@ closing inside an active span are attributed to it, and every span records
 its own wall time into the Timer registry under ``span:<path>``.
 
 :func:`report` returns the whole picture as one structured dict — including
-a ``memory`` block (``profiling.device_memory_stats`` + live-buffer bytes,
-best-effort, empty off-TPU) and a top-N ``programs`` block (per-cached-
-program dispatch counts; :func:`program_costs` adds flops / bytes-accessed /
-in-program collective estimates from each program's HLO, on demand because
-the estimate compiles). :func:`report_json` serializes it deterministically
+a ``memory`` block (``profiling.device_memory_stats``, live-buffer bytes,
+the owner-attributed ledger + high watermark and the admission-gate state
+from ``core/memledger.py``; best-effort, device stats empty off-TPU) and a
+top-N ``programs`` block (per-cached-program dispatch counts with a
+``cost_errors`` tally; :func:`program_costs` adds flops / bytes-accessed /
+in-program collective estimates *and static memory peaks* from each
+program's HLO, on demand because the estimate compiles). :func:`report_json` serializes it deterministically
 (tuple keys are joined, sets sorted — never ``default=str`` drift);
 ``HEAT_TPU_METRICS=<path>`` streams it as JSON-lines periodically and at
 exit (:func:`set_metrics_sink`) so long jobs are observable externally.
@@ -211,6 +217,13 @@ _EVENT_CAP = int(os.environ.get("HEAT_TPU_TELEMETRY_EVENTS", "8192"))
 
 #: programs shown in ``report()["programs"]`` (ranked by dispatch count)
 _TOP_PROGRAMS = int(os.environ.get("HEAT_TPU_TELEMETRY_TOP_PROGRAMS", "5"))
+
+#: memory-ledger sampling hook (``core/memledger.py`` installs its ``note``
+#: here at import — set-attribute, not import, so this module stays
+#: dependency-free). Called at the dispatch/force/collective/checkpoint
+#: record seams so the live-buffer high watermark tracks the events that
+#: change memory; None until the ledger module loads.
+_MEM_HOOK = None
 
 
 def active() -> bool:
@@ -376,11 +389,13 @@ def _cur() -> _State:
 
 def reset() -> None:
     """Clear every counter, span, event and completed scope of every active
-    state, and reset the ``utils/profiling`` timer registry with them (the
-    two report surfaces are joined — ``report()`` merges timers in, so a
-    reset that left them stale would mislabel the next bench's report). The
-    mode is left untouched; active :func:`scope`/:func:`span` stacks keep
-    recording."""
+    state, and reset the ``utils/profiling`` timer registry and the
+    ``core/memledger`` session state (watermark, gate counters, stored OOM
+    report — the budget arming itself is configuration and survives) with
+    them: the report surfaces are joined — ``report()`` merges timers and
+    the memory block in, so a reset that left either stale would mislabel
+    the next bench's report. The mode is left untouched; active
+    :func:`scope`/:func:`span` stacks keep recording."""
     for st in _STATES:
         st.clear()
     _SCOPES.clear()
@@ -388,6 +403,12 @@ def reset() -> None:
         from ..utils import profiling
 
         profiling.reset()
+    except Exception:  # pragma: no cover - import-order safety only
+        pass
+    try:
+        from . import memledger
+
+        memledger.reset()
     except Exception:  # pragma: no cover - import-order safety only
         pass
 
@@ -594,6 +615,8 @@ def record_collective(
     if _SPAN_STACK:
         for frame in _SPAN_STACK:
             frame.collectives[op] = frame.collectives.get(op, 0) + count
+    if _MEM_HOOK is not None:
+        _MEM_HOOK("collective")
 
 
 def _render_collectives(st: _State) -> Dict[str, Dict[str, Any]]:
@@ -665,6 +688,8 @@ def record_async_dispatch(
             st.async_["multi_root_batches"] += 1
     if _MODE >= 2:
         _emit("dispatch", roots=int(n_roots), cid=cid, cids=list(cids), program=program)
+    if _MEM_HOOK is not None:
+        _MEM_HOOK("dispatch")
 
 
 def record_blocking_sync(kind: str, cid: Optional[int] = None) -> Optional[dict]:
@@ -772,6 +797,8 @@ def record_force(trigger: str, depth: int, compiled: bool = False, cid: Optional
     if _SPAN_STACK:
         for frame in _SPAN_STACK:
             frame.forces += 1
+    if _MEM_HOOK is not None:
+        _MEM_HOOK("force")
 
 
 def _render_forces(st: _State) -> Dict[str, Dict[str, Any]]:
@@ -1016,6 +1043,8 @@ def record_checkpoint(event: str, step: Optional[int] = None, detail: str = "") 
         st.checkpoint[event] = st.checkpoint.get(event, 0) + 1
     if _MODE >= 2:
         _emit("checkpoint", event=event, step=step, detail=detail)
+    if _MEM_HOOK is not None:
+        _MEM_HOOK("checkpoint")
 
 
 def checkpoint_events() -> Dict[str, int]:
@@ -1130,12 +1159,26 @@ def spans() -> Dict[str, Dict[str, Any]]:
 # ----------------------------------------------------------------------
 def _memory_block() -> Dict[str, Any]:
     """Best-effort memory picture: per-device backend stats (TPU exposes
-    them; forced-host CPU returns {}) + live device-buffer bytes. Never
-    forces a chain, never raises — and never INITIALIZES anything: until the
-    mesh singleton exists the block stays empty, because report() (and the
-    background metrics sink) must not pin the JAX backend before the user
-    flips platforms (the lazy-singleton contract in heat_tpu/__init__.py)."""
-    out: Dict[str, Any] = {"device": {}, "live_buffers": {}}
+    them; forced-host CPU returns {}), live device-buffer bytes, the
+    owner-attributed ledger (``core/memledger.py``) and its high watermark,
+    plus the admission-gate configuration and any stored OOM forensic.
+    Never forces a chain, never raises — and never INITIALIZES anything:
+    until the mesh singleton exists only the jax-free state (watermark,
+    gate, OOM report) is included, because report() (and the background
+    metrics sink) must not pin the JAX backend before the user flips
+    platforms (the lazy-singleton contract in heat_tpu/__init__.py)."""
+    out: Dict[str, Any] = {"device": {}, "live_buffers": {}, "ledger": {}}
+    try:
+        from . import memledger
+
+        # pure module state — safe before any backend exists
+        out["watermark"] = memledger.watermark()
+        out["budget"] = memledger.budget_info()
+        oom = memledger.last_oom()
+        if oom is not None:
+            out["last_oom"] = oom
+    except Exception:  # pragma: no cover - import-order safety only
+        pass
     try:
         from . import communication
 
@@ -1144,7 +1187,11 @@ def _memory_block() -> Dict[str, Any]:
         from ..utils import health, profiling
 
         out["device"] = profiling.device_memory_stats()
+        out["host"] = profiling.host_memory_stats()
         out["live_buffers"] = health.memory_report()
+        from . import memledger
+
+        out["ledger"] = memledger.ledger()
     except Exception:  # pragma: no cover - backend-dependent
         pass
     return out
@@ -1152,8 +1199,11 @@ def _memory_block() -> Dict[str, Any]:
 
 def _programs_block(top: Optional[int] = None) -> Dict[str, Any]:
     """Top-N cached sharded programs by dispatch count (cheap metadata only;
-    memoized cost estimates are merged in when :func:`program_costs` has
-    been asked to compute them — report() itself never compiles)."""
+    memoized cost estimates — including each program's static memory peaks —
+    are merged in when :func:`program_costs` has been asked to compute them;
+    report() itself never compiles). ``cost_errors`` counts the programs
+    whose cost estimate failed in the backend (``fusion.cost_error_count``)
+    — failures are counted and warned once per session, never silent."""
     from . import fusion
 
     progs = fusion.programs()
@@ -1161,6 +1211,7 @@ def _programs_block(top: Optional[int] = None) -> Dict[str, Any]:
     n = _TOP_PROGRAMS if top is None else top
     return {
         "cached": len(progs),
+        "cost_errors": fusion.cost_error_count(),
         "top": [dict(rec, key=key) for key, rec in ranked[:n]],
     }
 
@@ -1305,6 +1356,8 @@ _INSTANT_KINDS = {
     "checkpoint": ("checkpoint", lambda ev: "checkpoint:" + str(ev.get("event"))),
     "checkpoint_phase": ("checkpoint", lambda ev: "ckpt:" + str(ev.get("phase"))),
     "nonfinite": ("errstate", lambda ev: "nonfinite:" + str(ev.get("where"))),
+    "memory_gate": ("memory", lambda ev: "gate:" + str(ev.get("policy"))),
+    "memory_oom": ("memory", lambda ev: "oom:" + str(ev.get("program"))),
 }
 
 
@@ -1387,6 +1440,18 @@ def trace_events(evs: Optional[List[dict]] = None, pid: Optional[int] = None) ->
         elif kind == "dispatch":
             out.append({"ph": "i", "s": "t", "cat": "dispatch", "name": "dispatch",
                         "pid": pid, "tid": tid, "ts": ts, "args": args_of(ev)})
+        elif kind == "memory":
+            # counter ("C") tracks per host: Perfetto renders each args key
+            # as a stacked series — one track for the owner-attributed live
+            # bytes, one for the high watermark
+            series = {"total": int(ev.get("total", 0))}
+            for owner, nbytes in (ev.get("by_owner") or {}).items():
+                series[str(owner)] = int(nbytes)
+            out.append({"ph": "C", "cat": "memory", "name": "live_bytes",
+                        "pid": pid, "tid": tid, "ts": ts, "args": series})
+            out.append({"ph": "C", "cat": "memory", "name": "live_bytes_watermark",
+                        "pid": pid, "tid": tid, "ts": ts,
+                        "args": {"watermark": int(ev.get("watermark", 0))}})
         else:
             cat, name_of = _INSTANT_KINDS.get(kind, ("event", lambda e, k=kind: str(k)))
             out.append({"ph": "i", "s": "t", "cat": cat, "name": name_of(ev),

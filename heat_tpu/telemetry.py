@@ -8,6 +8,8 @@ exported Chrome/Perfetto trace files without writing any analysis code:
     $ python -m heat_tpu.telemetry show telemetry.json
     $ python -m heat_tpu.telemetry diff before.json after.json
     $ python -m heat_tpu.telemetry validate-trace trace.json
+    $ python -m heat_tpu.telemetry memory                 # live process ledger
+    $ python -m heat_tpu.telemetry memory report.json --json
 
 The implementation (and all state) lives in :mod:`heat_tpu.core.telemetry`;
 this module is a thin proxy (``heat_tpu.telemetry.report`` etc. delegate
@@ -132,6 +134,120 @@ def _show(doc: Dict[str, Any], out) -> None:
 
 
 # ----------------------------------------------------------------------
+# memory: live ledger + watermark + per-program static peaks
+# ----------------------------------------------------------------------
+def _memory_doc(report_path: Optional[str], top: int) -> Dict[str, Any]:
+    """The memory picture to render: a saved report's ``memory``/``programs``
+    blocks when a path is given, else THIS process's live ledger (brings up
+    the mesh and computes per-program costs — the interactive debug mode)."""
+    if report_path is not None:
+        doc = _load(report_path)
+        return {
+            "source": report_path,
+            "memory": doc.get("memory") or {},
+            "programs": doc.get("programs") or {},
+        }
+    import heat_tpu as ht  # noqa: F401 - the mesh must exist for a live ledger
+
+    ht.get_comm()
+    from heat_tpu.core import fusion, memledger
+
+    return {
+        "source": "<live>",
+        "memory": {
+            "ledger": memledger.ledger(top=top),
+            "watermark": memledger.watermark(),
+            "budget": memledger.budget_info(resolve=True),  # mesh is up here
+            "last_oom": memledger.last_oom(),
+        },
+        "programs": {
+            "cached": len(fusion.cache_stats()["program_keys"]),
+            "cost_errors": fusion.cost_error_count(),
+            "top": [
+                dict(rec, key=key)
+                for key, rec in fusion.program_costs(top=top).items()
+            ],
+        },
+    }
+
+
+def _show_memory(doc: Dict[str, Any], out) -> None:
+    mem = doc.get("memory") or {}
+    led = mem.get("ledger") or {}
+    print(f"memory ({doc.get('source', '?')}):", file=out)
+    if led:
+        print(
+            f"  live: {_fmt_bytes(led.get('total_bytes', 0))} over "
+            f"{led.get('buffers', led.get('buffer_count', 0))} buffer(s)",
+            file=out,
+        )
+        for owner, nbytes in sorted(
+            (led.get("by_owner") or {}).items(), key=lambda kv: -kv[1]
+        ):
+            print(f"    {owner:<14} {_fmt_bytes(nbytes)}", file=out)
+        for rec in led.get("top") or []:
+            print(
+                f"    top: {_fmt_bytes(rec.get('nbytes', 0)):<10} "
+                f"{rec.get('owner', '?'):<14} {rec.get('dtype', '?')}"
+                f"{rec.get('shape', [])}",
+                file=out,
+            )
+    wm = mem.get("watermark") or {}
+    if wm:
+        print(
+            f"  watermark: {_fmt_bytes(wm.get('bytes', 0))} "
+            f"(event {wm.get('event')}, {wm.get('samples', 0)} samples) "
+            f"{wm.get('by_owner', {})}",
+            file=out,
+        )
+    budget = mem.get("budget") or {}
+    if budget.get("budget") is not None:
+        print(
+            f"  budget: {_fmt_bytes(budget.get('budget_bytes'))} "
+            f"policy={budget.get('policy')} checks={budget.get('checks', 0)} "
+            f"exceeded={budget.get('exceeded', 0)} drains={budget.get('drains', 0)}",
+            file=out,
+        )
+    oom = mem.get("last_oom")
+    if oom:
+        print(
+            f"  LAST OOM: program {oom.get('program')} ({oom.get('family')}) "
+            f"static peak {_fmt_bytes(oom.get('static_peak_bytes'))}, live "
+            f"{_fmt_bytes(oom.get('live_total_bytes', 0))} by owner "
+            f"{oom.get('by_owner', {})}",
+            file=out,
+        )
+    dev = mem.get("device") or {}
+    for name, stats in sorted(dev.items()):
+        line = ", ".join(f"{k}={_fmt_bytes(v)}" for k, v in sorted(stats.items()))
+        print(f"  {name}: {line}", file=out)
+    progs = doc.get("programs") or {}
+    top_progs = progs.get("top") or []
+    if top_progs:
+        print(
+            f"per-program static peaks (of {progs.get('cached', 0)} cached, "
+            f"{progs.get('cost_errors', 0)} cost error(s)):",
+            file=out,
+        )
+        for rec in top_progs:
+            memrec = (rec.get("cost") or rec).get("memory") or {}
+            peak = memrec.get("peak_bytes")
+            line = (
+                f"  {rec.get('key', '?'):<18} x{rec.get('dispatches', 0):<6} "
+                f"{str(rec.get('family', ''))[:48]:<48} "
+            )
+            if peak is not None:
+                line += (
+                    f"peak {_fmt_bytes(peak)} (args {_fmt_bytes(memrec.get('argument_bytes', 0))}"
+                    f" + out {_fmt_bytes(memrec.get('output_bytes', 0))}"
+                    f" + temp {_fmt_bytes(memrec.get('temp_bytes', 0))})"
+                )
+            else:
+                line += "peak n/a"
+            print(line, file=out)
+
+
+# ----------------------------------------------------------------------
 # diff
 # ----------------------------------------------------------------------
 def _flatten_numeric(doc, prefix="") -> Dict[str, float]:
@@ -184,6 +300,20 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
     p_diff = sub.add_parser("diff", help="diff two report_json artifacts (b - a)")
     p_diff.add_argument("a")
     p_diff.add_argument("b")
+    p_mem = sub.add_parser(
+        "memory",
+        help="live-buffer ledger + watermark + per-program static peaks "
+        "(from a report_json artifact, or live from this process)",
+    )
+    p_mem.add_argument(
+        "report",
+        nargs="?",
+        default=None,
+        help="a report_json artifact; omitted = sample THIS process live "
+        "(brings up the mesh)",
+    )
+    p_mem.add_argument("--json", action="store_true", help="emit JSON instead of text")
+    p_mem.add_argument("--top", type=int, default=5, help="top-K buffers/programs shown")
     p_val = sub.add_parser(
         "validate-trace", help="check a Chrome/Perfetto trace-event JSON file"
     )
@@ -206,6 +336,13 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
         return 0
     if args.cmd == "diff":
         _diff(_load(args.a), _load(args.b), out)
+        return 0
+    if args.cmd == "memory":
+        doc = _memory_doc(args.report, top=args.top)
+        if args.json:
+            print(json.dumps(_core._jsonable(doc), indent=2, sort_keys=True), file=out)
+        else:
+            _show_memory(doc, out)
         return 0
     if args.cmd == "validate-trace":
         problems = _core.validate_trace(args.trace, cross_host=args.cross_host)

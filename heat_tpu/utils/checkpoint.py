@@ -98,7 +98,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
-from ..core import resilience, telemetry
+from ..core import memledger, resilience, telemetry
 
 __all__ = [
     "CheckpointCorruptError",
@@ -855,9 +855,13 @@ def _restore_dndarray(directory: str, entry: dict, template) -> Any:
             "checkpoint.restore", read_block, tuple(slice(0, s) for s in gshape)
         )
         return factories.array(full, dtype=dtype, split=None, device=device, comm=comm)
-    return io_module._sharded_ingest(
-        read_block, gshape, dtype, int(out_split) % len(gshape), device, comm
-    )
+    with memledger.owner_scope("checkpoint"):
+        # restore staging buffers (the ingest's per-device pieces) attribute
+        # to "checkpoint" in the live-buffer ledger — a watermark sample
+        # taken mid-restore names this subsystem, not "unattributed"
+        return io_module._sharded_ingest(
+            read_block, gshape, dtype, int(out_split) % len(gshape), device, comm
+        )
 
 
 def _restore_manifest(directory: str, step: int, target: Any) -> Any:

@@ -108,25 +108,78 @@ def assert_mesh_healthy(comm: Optional[MeshCommunication] = None, timeout: float
     return info
 
 
-def memory_report(comm: Optional[MeshCommunication] = None) -> dict:
-    """Live device-buffer bytes per device of ``comm``'s mesh (and total),
-    from ``jax.live_arrays()`` — the leak-triage companion of the reference's
+def memory_report(comm: Optional[MeshCommunication] = None, top: int = 5) -> dict:
+    """Live device-buffer bytes per device of ``comm``'s mesh, from
+    ``jax.live_arrays()`` — the leak-triage companion of the reference's
     (non-existent) memory tooling; exceeds reference scope like
-    utils/profiling does."""
+    utils/profiling does.
+
+    Buffers are deduped with the ledger's own key (``memledger._buffer_key``
+    — (device, buffer pointer), so the two surfaces can never disagree on
+    what "one buffer" is), meaning a buffer addressable from multiple
+    shards is never double-counted; deleted/donated arrays are
+    skipped via ``is_deleted()`` plus the narrow ``RuntimeError`` the racing
+    shards read raises — no blanket except. Returns ``total_bytes``,
+    ``per_device_bytes``, the deduped ``buffer_count`` and the ``top``-K
+    largest buffers (shape/dtype/bytes, owner-attributed via the
+    ``core/memledger`` registry)."""
+    from ..core import memledger
+
     comm = sanitize_comm(comm)
     mesh_devices = {str(d) for d in comm.devices}
     per_device: dict = {}
     total = 0
-    for arr in jax.live_arrays():
+    buffer_count = 0
+    seen: set = set()
+    largest: list = []
+    # attributed arrays claim their buffers first (same ordering rule as
+    # memledger._scan): a global sharded array and its per-shard children
+    # are BOTH live arrays over the same device buffers, and the dedupe
+    # must not let enumeration order hand the bytes to an untagged child
+    ranked = sorted(
+        jax.live_arrays(),
+        key=lambda arr: memledger._owner_of(arr) == memledger.UNATTRIBUTED,
+    )
+    for arr in ranked:
         try:
+            if arr.is_deleted():
+                continue
             shards = arr.addressable_shards
-        except Exception:  # pragma: no cover - deleted/donated buffers
+        except RuntimeError:  # deleted/donated between the check and the read
             continue
-        for s in shards:
+        arr_bytes = 0
+        for i, s in enumerate(shards):
             key = str(s.device)
             if key not in mesh_devices:
                 continue
-            nbytes = int(s.data.nbytes)
+            ident = memledger._buffer_key(s, arr, i)
+            if ident in seen:
+                continue
+            seen.add(ident)
+            try:
+                nbytes = int(s.data.nbytes)
+            except RuntimeError:  # deleted mid-walk
+                continue
             per_device[key] = per_device.get(key, 0) + nbytes
             total += nbytes
-    return {"total_bytes": total, "per_device_bytes": per_device}
+            arr_bytes += nbytes
+            buffer_count += 1
+        if arr_bytes:
+            largest.append(
+                (
+                    arr_bytes,
+                    {
+                        "nbytes": arr_bytes,
+                        "shape": [int(d) for d in arr.shape],
+                        "dtype": str(arr.dtype),
+                        "owner": memledger._owner_of(arr),
+                    },
+                )
+            )
+    largest.sort(key=lambda t: -t[0])
+    return {
+        "total_bytes": total,
+        "per_device_bytes": per_device,
+        "buffer_count": buffer_count,
+        "top_buffers": [rec for _, rec in largest[: max(0, int(top))]],
+    }

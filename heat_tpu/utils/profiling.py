@@ -16,12 +16,16 @@ replacement. This module provides:
 * :func:`report` — aggregate {name: {calls, total_s, mean_s, best_s}}.
 * :func:`device_memory_stats` — per-device live-bytes snapshot where the
   backend exposes it (TPU does; forced-host CPU returns {}).
+* :func:`host_memory_stats` — current/peak RSS + physical total of THIS
+  process's host, the fallback memory surface on CPU meshes (and the
+  denominator for fractional ``HEAT_TPU_MEMORY_BUDGET`` specs there).
 """
 
 from __future__ import annotations
 
 import contextlib
 import functools
+import os
 import time
 from typing import Any, Callable, Dict, Optional
 
@@ -35,6 +39,7 @@ __all__ = [
     "Timer",
     "annotate",
     "device_memory_stats",
+    "host_memory_stats",
     "record_timing",
     "report",
     "reset",
@@ -176,4 +181,37 @@ def device_memory_stats() -> Dict[str, Dict[str, int]]:
                 for k, v in stats.items()
                 if isinstance(v, (int, float)) and "bytes" in k
             }
+    return out
+
+
+def host_memory_stats() -> Dict[str, int]:
+    """This process's host memory picture: current/peak RSS and the
+    machine's physical total — the memory surface that matters on forced-
+    host CPU meshes where ``device_memory_stats`` is empty (the XLA CPU
+    backend reports no memory_stats), and the denominator a fractional
+    ``HEAT_TPU_MEMORY_BUDGET`` resolves against there. Best-effort: keys
+    are present only where the platform exposes them."""
+    out: Dict[str, int] = {}
+    try:
+        page = int(os.sysconf("SC_PAGE_SIZE"))
+        with open("/proc/self/statm") as fh:
+            rss_pages = int(fh.read().split()[1])
+        out["rss_bytes"] = rss_pages * page
+    except (OSError, ValueError, IndexError):  # pragma: no cover - non-Linux
+        pass
+    try:
+        import resource
+
+        # ru_maxrss is KiB on Linux
+        out["peak_rss_bytes"] = int(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        )
+    except (ImportError, ValueError, OSError):  # pragma: no cover - non-POSIX
+        pass
+    try:
+        out["total_bytes"] = int(os.sysconf("SC_PAGE_SIZE")) * int(
+            os.sysconf("SC_PHYS_PAGES")
+        )
+    except (OSError, ValueError, AttributeError):  # pragma: no cover - non-POSIX
+        pass
     return out

@@ -55,6 +55,15 @@ telemetry.export_trace("/tmp/heat_tpu_matrix_trace.json")
 PY
 HEAT_TPU_TELEMETRY=verbose \
   python -m heat_tpu.telemetry validate-trace /tmp/heat_tpu_matrix_trace.json
+# memory-observability leg: the headroom admission gate is ARMED (a generous
+# fraction of host memory under the warn policy — every fused dispatch pays
+# the live-ledger check without any policy actually firing) while the memory
+# suite and the eager-chain suite run; the gate/ledger/forensics must change
+# no results and the suite's own warn|raise|drain pins stay exact (tests
+# re-arm their own budgets per test and restore the ambient one)
+echo "=== memory observability (HEAT_TPU_MEMORY_BUDGET armed) ==="
+HEAT_TPU_MEMORY_BUDGET=0.95 HEAT_TPU_MEMORY_POLICY=warn HEAT_TPU_TELEMETRY=1 \
+  python -m pytest tests/test_memory_obs.py tests/test_eager_chain.py -q -x
 # resilience leg: the suite runs under the deterministic ambient fault mix
 # (core/resilience.py 'ci' preset: fused compiles/executes fail periodically
 # and degrade to eager, transient io errors are retried, checkpoint
@@ -67,7 +76,8 @@ echo "=== faults injected (HEAT_TPU_FAULTS=ci) ==="
 HEAT_TPU_FAULTS=ci HEAT_TPU_TELEMETRY=1 \
   python -m pytest tests/test_resilience.py tests/test_resilience_io.py tests/test_io_errors.py \
     tests/test_checkpoint_resilience.py tests/test_checkpoint_profiling.py \
-    tests/test_fused_collectives.py tests/test_trace_timeline.py -q -x
+    tests/test_fused_collectives.py tests/test_trace_timeline.py \
+    tests/test_memory_obs.py -q -x
 # static-analysis leg (heat_tpu/analysis): the AST lint must be clean
 # against the committed baseline (zero NEW findings — suppressions carry
 # their justifications inline), and the AOT program auditor over a cache

@@ -1,0 +1,517 @@
+"""Memory observability (ISSUE 8): live-buffer ledger, high watermark,
+per-program static peaks, the headroom admission gate and OOM forensics.
+
+Pins the acceptance criteria: ``report()["memory"]`` shows owner-attributed
+live bytes and a high watermark; a fused dispatch over
+``HEAT_TPU_MEMORY_BUDGET`` triggers the configured policy (pinned for all
+three of ``warn``/``raise``/``drain``); an injected ``memory.exhausted``
+fault yields a forensic report naming the top buffer owners and the failing
+program key; Perfetto exports carry per-host counter ("C") tracks and still
+validate; and ledger emission/sampling never forces a pending chain. Runs
+green at mesh 1/3/8 (matrix legs), with fusion off (dispatch-seam tests
+skip), under ``HEAT_TPU_FAULTS=ci`` (explicit injections suspend the
+ambient mix) and with ``HEAT_TPU_MEMORY_BUDGET`` armed from the environment
+(setUp re-arms per test and tearDown restores the ambient gate).
+"""
+
+import io
+import json
+import importlib
+import os
+import tempfile
+import unittest
+import warnings
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.core import fusion, memledger, resilience, telemetry
+from heat_tpu.utils import health
+
+from harness import TestCase
+
+
+class MemCase(TestCase):
+    """Clean ledger/gate state, exact under the ambient CI fault mix and
+    with the matrix leg's env budget disarmed for the test body."""
+
+    def setUp(self):
+        self._suspend = resilience.suspended()
+        self._suspend.__enter__()
+        fusion.clear_cache()
+        telemetry.reset()
+        memledger.reset()
+        self._prev_budget = memledger.set_budget(None)
+
+    def tearDown(self):
+        memledger.set_budget(self._prev_budget[0], self._prev_budget[1])
+        memledger.reset()
+        telemetry.reset()
+        self._suspend.__exit__(None, None, None)
+
+    def _split_input(self, seed=0, n_mult=4):
+        n = n_mult * self.get_size()
+        return ht.array(
+            np.random.default_rng(seed).standard_normal((n, 3)).astype(np.float32),
+            split=0,
+        )
+
+
+class TestLedgerAttribution(MemCase):
+    def test_dndarray_payload_attributed(self):
+        a = self._split_input()
+        phys = a.parray  # forced + claimed by the wrapper
+        led = memledger.ledger()
+        self.assertGreaterEqual(led["by_owner"].get("dndarray", 0), int(phys.nbytes))
+        self.assertGreaterEqual(led["total_bytes"], led["by_owner"]["dndarray"])
+        self.assertGreater(led["buffers"], 0)
+
+    def test_ledger_shape_and_top(self):
+        a = self._split_input(n_mult=8)
+        a.parray
+        led = memledger.ledger(top=3)
+        self.assertLessEqual(len(led["top"]), 3)
+        self.assertTrue(led["top"], "expected at least one top buffer")
+        tops = [rec["nbytes"] for rec in led["top"]]
+        self.assertEqual(tops, sorted(tops, reverse=True))
+        for rec in led["top"]:
+            self.assertIn("owner", rec)
+            self.assertIn("dtype", rec)
+
+    def test_foreign_array_is_unattributed(self):
+        import jax
+
+        keep = jax.device_put(np.ones((64, 8), dtype=np.float32))  # noqa: F841
+        led = memledger.ledger()
+        self.assertGreaterEqual(led["by_owner"].get("unattributed", 0), 64 * 8 * 4)
+
+    @unittest.skipUnless(fusion.collectives_active(), "needs multi-root batching")
+    def test_unclaimed_async_future_is_fusion_owned(self):
+        a = self._split_input()
+        pending = a + 1.0  # small live root, batched but never claimed
+        trigger = a * 2.0
+        float(trigger.sum())
+        self.assertIsNotNone(pending._payload._value)  # batched along
+        led = memledger.ledger()
+        self.assertGreater(led["by_owner"].get("fusion", 0), 0)
+
+    def test_owner_scope_tags_default(self):
+        import jax
+
+        arr = jax.device_put(np.zeros((4,), dtype=np.float32))
+        with memledger.owner_scope("checkpoint"):
+            self.assertEqual(memledger.current_owner(), "checkpoint")
+            memledger.tag(arr)
+        self.assertIsNone(memledger.current_owner())
+        self.assertEqual(memledger._owner_of(arr), "checkpoint")
+
+    @unittest.skipUnless(fusion.active(), "fusion disabled via HEAT_TPU_FUSION")
+    def test_emission_never_forces(self):
+        a = self._split_input()
+        x = ht.sqrt(ht.abs(a) + 1.0)
+        self.assertTrue(fusion.is_deferred(x))
+        memledger.ledger(top=8)
+        memledger.sample("test", force=True)
+        telemetry.report()  # the memory block rides report() too
+        self.assertTrue(fusion.is_deferred(x), "ledger emission forced the chain")
+
+
+class TestWatermark(MemCase):
+    def test_watermark_tracks_live_bytes(self):
+        with telemetry.enabled():
+            a = self._split_input(n_mult=16)
+            float((a * 2.0).sum())
+            memledger.sample("test", force=True)
+        wm = memledger.watermark()
+        self.assertGreaterEqual(wm["bytes"], int(a.parray.nbytes))
+        self.assertTrue(wm["by_owner"], "watermark carries the owner split")
+        self.assertGreater(wm["samples"], 0)
+
+    def test_watermark_in_report_memory_block(self):
+        with telemetry.enabled():
+            a = self._split_input()
+            a.parray
+            memledger.sample("test", force=True)
+            mem = telemetry.report()["memory"]
+        self.assertIn("ledger", mem)
+        self.assertIn("watermark", mem)
+        self.assertGreaterEqual(mem["ledger"]["by_owner"].get("dndarray", 0), 1)
+        self.assertGreaterEqual(mem["watermark"]["bytes"], 1)
+        self.assertIn("budget", mem)
+
+    def test_reset_watermark(self):
+        memledger.sample("test", force=True)
+        memledger.reset_watermark()
+        wm = memledger.watermark()
+        self.assertEqual((wm["bytes"], wm["samples"]), (0, 0))
+
+    def test_nonforced_samples_throttle(self):
+        prev = memledger.set_enabled(True)
+        try:
+            memledger.sample("warmup", force=True)  # stamps the throttle clock
+            self.assertIsNone(memledger.sample("immediately-after"))
+        finally:
+            memledger.set_enabled(prev)
+
+
+@unittest.skipUnless(fusion.active(), "fusion disabled via HEAT_TPU_FUSION")
+class TestBudgetGate(MemCase):
+    def _chain(self, seed=1):
+        a = self._split_input(seed)
+        return a, ht.sqrt(ht.abs(a * 1.5 + 2.0)) - 0.5
+
+    def test_warn_policy(self):
+        a, x = self._chain()
+        memledger.set_budget(1, "warn")
+        with self.assertWarns(memledger.MemoryBudgetWarning):
+            got = float(x.sum())
+        expect = float(np.sum(np.sqrt(np.abs(np.asarray(a.larray) * 1.5 + 2.0)) - 0.5))
+        self.assertAlmostEqual(got, expect, places=3)
+        stats = memledger.gate_stats()
+        self.assertGreaterEqual(stats["exceeded"], 1)
+        self.assertGreaterEqual(stats["warned"], 1)
+
+    def test_warn_once_per_program_key(self):
+        memledger.set_budget(1, "warn")
+        _, x = self._chain(2)
+        with self.assertWarns(memledger.MemoryBudgetWarning):
+            x.parray  # force the chain itself: a single-root program
+        # a structurally identical chain (same family/shapes/shardings) hits
+        # the SAME program key — forcing via parray again keeps the dispatch
+        # single-root, so no batching can change the key between the two
+        _, x2 = self._chain(3)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            x2.parray
+        again = [w for w in caught if issubclass(w.category, memledger.MemoryBudgetWarning)]
+        self.assertEqual(again, [], "the same program key warned twice")
+
+    def test_raise_policy_leaves_chain_pending(self):
+        a, x = self._chain(4)
+        memledger.set_budget(1, "raise")
+        with self.assertRaises(memledger.MemoryBudgetExceeded):
+            float(x.sum())
+        self.assertTrue(fusion.is_deferred(x), "refused dispatch consumed the chain")
+        self.assertGreaterEqual(memledger.gate_stats()["raised"], 1)
+        memledger.set_budget(None)
+        expect = float(np.sum(np.sqrt(np.abs(np.asarray(a.larray) * 1.5 + 2.0)) - 0.5))
+        self.assertAlmostEqual(float(x.sum()), expect, places=3)
+
+    def test_drain_policy_syncs_outstanding_roots(self):
+        # a big disjoint pending root (too large to batch into the trigger)
+        big = ht.ones((4096 * self.get_size(), 8), split=0) * 2.0
+        self.assertTrue(fusion.is_deferred(big))
+        _, x = self._chain(5)
+        memledger.set_budget(1, "drain")
+        with self.assertWarns(memledger.MemoryBudgetWarning):  # still over after drain
+            float(x.sum())
+        stats = memledger.gate_stats()
+        self.assertGreaterEqual(stats["drains"], 1)
+        self.assertGreaterEqual(stats["drained_roots"], 1)
+        self.assertFalse(fusion.is_deferred(big), "drain left the root pending")
+
+    def test_drain_never_redispatches_the_gated_chain(self):
+        # regression: the drain's recursive forces (and their own batch
+        # gathering) must not absorb any node of the chain held at the gate
+        # — that would dispatch the gated chain twice when admit() returns
+        big = ht.ones((4096 * self.get_size(), 8), split=0) * 2.0  # unbatchable
+        a = self._split_input(20)
+        x = ht.exp(a * 0.5) + 1.0  # small pending chain, then its reduction
+        memledger.set_budget(1, "drain")
+        with warnings.catch_warnings(record=True):
+            warnings.simplefilter("always")
+            got = float(x.sum())
+        expect = float(np.sum(np.exp(np.asarray(a.larray) * 0.5) + 1.0))
+        self.assertAlmostEqual(got / expect, 1.0, places=5)
+        for rec in fusion.programs().values():
+            self.assertEqual(rec["dispatches"], 1, rec)
+            # no program batched the gated chain alongside the drained root
+            self.assertEqual(rec["roots"], 1, rec)
+
+    def test_generous_budget_admits(self):
+        _, x = self._chain(6)
+        memledger.set_budget(0.99, "warn")  # fraction of device/host memory
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            float(x.sum())
+        gates = [w for w in caught if issubclass(w.category, memledger.MemoryBudgetWarning)]
+        self.assertEqual(gates, [])
+        self.assertGreaterEqual(memledger.gate_stats()["allowed"], 1)
+
+    def test_parse_budget(self):
+        self.assertEqual(memledger.parse_budget("512MiB"), 512 * (1 << 20))
+        self.assertEqual(memledger.parse_budget("2kb"), 2000)
+        self.assertEqual(memledger.parse_budget("2G"), 2 << 30)  # bare = binary
+        self.assertEqual(memledger.parse_budget(4096), 4096)
+        self.assertEqual(memledger.parse_budget("0.5"), 0.5)
+        self.assertIsNone(memledger.parse_budget("off"))
+        self.assertIsNone(memledger.parse_budget(None))
+        self.assertIsNone(memledger.parse_budget("0"))
+
+    def test_malformed_env_budget_warns_and_disarms(self):
+        # a typo'd HEAT_TPU_MEMORY_BUDGET must never make import raise: the
+        # module-level parse goes through this warn-and-disarm wrapper
+        with self.assertWarns(UserWarning):
+            self.assertIsNone(memledger._parse_env_budget("zz.bogus"))
+        self.assertEqual(memledger._parse_env_budget("1MiB"), 1 << 20)
+
+    def test_steady_overrun_skips_attributed_scan_after_warning(self):
+        memledger.set_budget(1, "warn")
+        _, x = self._chain(7)
+        with self.assertWarns(memledger.MemoryBudgetWarning):
+            x.parray
+        before = memledger.gate_stats()
+        _, x2 = self._chain(8)  # same key, already warned
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            x2.parray
+        after = memledger.gate_stats()
+        self.assertEqual(after["warned"], before["warned"])  # suppressed
+        self.assertEqual(after["exceeded"], before["exceeded"] + 1)
+        gates = [w for w in caught if issubclass(w.category, memledger.MemoryBudgetWarning)]
+        self.assertEqual(gates, [])
+
+    def test_telemetry_reset_clears_memledger_session_state(self):
+        memledger.sample("test", force=True)
+        self.assertGreater(memledger.watermark()["samples"], 0)
+        telemetry.reset()
+        wm = memledger.watermark()
+        self.assertEqual((wm["bytes"], wm["samples"]), (0, 0))
+        self.assertIsNone(memledger.last_oom())
+
+    def test_budget_info_shape(self):
+        memledger.set_budget("1GiB", "drain")
+        info = memledger.budget_info()
+        self.assertEqual(info["budget_bytes"], 1 << 30)
+        self.assertEqual(info["policy"], "drain")
+        self.assertIn("checks", info)
+
+
+@unittest.skipUnless(fusion.active(), "fusion disabled via HEAT_TPU_FUSION")
+class TestOOMForensics(MemCase):
+    def test_injected_exhaustion_yields_forensics_and_degrades(self):
+        a = self._split_input(7)
+        with telemetry.enabled():
+            x = ht.exp(a * 0.25) + 1.0
+            with resilience.inject("memory.exhausted", times=1):
+                with warnings.catch_warnings(record=True) as caught:
+                    warnings.simplefilter("always")
+                    got = float(x.sum())
+            kinds = {w.category for w in caught}
+        self.assertIn(memledger.MemoryExhaustedWarning, kinds)
+        self.assertIn(resilience.DegradedDispatchWarning, kinds)  # guarded path ran
+        expect = float(np.sum(np.exp(np.asarray(a.larray) * 0.25) + 1.0))
+        self.assertAlmostEqual(got / expect, 1.0, places=5)
+        report = memledger.last_oom()
+        self.assertIsNotNone(report)
+        self.assertTrue(report["program"], "forensic must name the failing program key")
+        self.assertIn("memory.exhausted", report["error"])
+        self.assertIsInstance(report["by_owner"], dict)
+        self.assertTrue(report["by_owner"], "forensic must rank live owners")
+        self.assertIsInstance(report["top_buffers"], list)
+        self.assertIn("static_peak_bytes", report)
+        # the warning text itself names owners (the log is often all we get)
+        text = str(next(w.message for w in caught
+                        if w.category is memledger.MemoryExhaustedWarning))
+        self.assertIn("by owner", text)
+
+    def test_forensics_carry_recent_dispatches_verbose(self):
+        prev = telemetry.set_mode("verbose")
+        try:
+            a = self._split_input(8)
+            float((a + 1.0).sum())  # a dispatch on the timeline first
+            y = ht.log(ht.abs(a) + 2.0)
+            with resilience.inject("memory.exhausted", times=1):
+                with warnings.catch_warnings(record=True):
+                    warnings.simplefilter("always")
+                    float(y.sum())
+        finally:
+            telemetry.set_mode(prev)
+        report = memledger.last_oom()
+        self.assertTrue(report["recent_dispatches"])
+        self.assertIn("program", report["recent_dispatches"][-1])
+
+    def test_oom_counts_into_degraded_telemetry(self):
+        with telemetry.enabled():
+            a = self._split_input(9)
+            z = ht.sin(a) * 0.5
+            with resilience.inject("memory.exhausted", times=1):
+                with warnings.catch_warnings(record=True):
+                    warnings.simplefilter("always")
+                    float(z.sum())
+            self.assertGreaterEqual(sum(telemetry.degraded_counts().values()), 1)
+
+    def test_is_oom_classification(self):
+        self.assertTrue(memledger.is_oom(MemoryError("boom")))
+        self.assertTrue(memledger.is_oom(RuntimeError("RESOURCE_EXHAUSTED: out of memory")))
+        self.assertTrue(memledger.is_oom(RuntimeError("Out of memory allocating 1GB")))
+        self.assertFalse(memledger.is_oom(ValueError("shape mismatch")))
+        self.assertFalse(memledger.is_oom(RuntimeError("deadline exceeded")))
+
+
+@unittest.skipUnless(fusion.active(), "fusion disabled via HEAT_TPU_FUSION")
+class TestStaticPeaks(MemCase):
+    def test_program_costs_carry_memory_analysis(self):
+        a = self._split_input(10)
+        float((ht.sqrt(ht.abs(a)) + 3.0).sum())
+        costs = fusion.program_costs()
+        self.assertTrue(costs)
+        with_mem = [c for c in costs.values() if c.get("memory")]
+        self.assertTrue(with_mem, "no program banked an XLA memory analysis")
+        mem = with_mem[0]["memory"]
+        self.assertEqual(
+            mem["peak_bytes"],
+            mem["argument_bytes"] + mem["output_bytes"] + mem["temp_bytes"],
+        )
+        self.assertGreater(mem["peak_bytes"], 0)
+
+    def test_report_programs_cost_errors_counter(self):
+        a = self._split_input(11)
+        float((a * 2.0).sum())
+        fusion.program_costs()
+        block = telemetry.report()["programs"]
+        self.assertIn("cost_errors", block)
+        self.assertIsInstance(block["cost_errors"], int)
+
+    def test_cost_error_noting_warns_once(self):
+        prev_keys = set(fusion._COST_ERROR_KEYS)
+        prev_warned = fusion._COST_ERROR_WARNED
+        fusion._COST_ERROR_KEYS.clear()
+        fusion._COST_ERROR_WARNED = False
+        try:
+            with self.assertWarns(fusion.ProgramCostWarning):
+                fusion._note_cost_error("k1", {"error": "boom"})
+            self.assertEqual(fusion.cost_error_count(), 1)
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                fusion._note_cost_error("k2", {"error": "boom2"})
+            self.assertEqual(
+                [w for w in caught if issubclass(w.category, fusion.ProgramCostWarning)],
+                [],
+                "cost-estimate failures must warn once per session",
+            )
+            self.assertEqual(fusion.cost_error_count(), 2)
+            fusion._note_cost_error("k1", {"flops": 1.0})  # success clears the key
+            self.assertEqual(fusion.cost_error_count(), 1)
+        finally:
+            fusion._COST_ERROR_KEYS.clear()
+            fusion._COST_ERROR_KEYS.update(prev_keys)
+            fusion._COST_ERROR_WARNED = prev_warned
+
+    def test_audit_peak_budget_flags_programs(self):
+        from heat_tpu import analysis
+
+        a = self._split_input(12)
+        float((ht.abs(a) + 1.0).sum())
+        fusion.program_costs()  # memoize (audit_programs re-lowers anyway)
+        findings = analysis.audit_programs(peak_budget=1)
+        mem_findings = [f for f in findings if f.kind == "memory"]
+        self.assertTrue(mem_findings, "1-byte peak budget must flag every program")
+        self.assertIn("static memory peak", mem_findings[0].message)
+        self.assertEqual(analysis.audit_programs(peak_budget=1 << 40), [])
+
+
+class TestPerfettoCounterTracks(MemCase):
+    def test_memory_events_export_as_counter_tracks(self):
+        prev = telemetry.set_mode("verbose")
+        try:
+            a = self._split_input(13)
+            float((a * 1.5).sum())
+            memledger.sample("test", force=True)
+            with tempfile.TemporaryDirectory() as td:
+                path = os.path.join(td, "trace.json")
+                doc = telemetry.export_trace(path)
+                self.assertEqual(telemetry.validate_trace(path), [])
+        finally:
+            telemetry.set_mode(prev)
+        counters = [ev for ev in doc["traceEvents"] if ev.get("ph") == "C"]
+        self.assertTrue(counters, "no counter tracks exported")
+        names = {ev["name"] for ev in counters}
+        self.assertIn("live_bytes", names)
+        self.assertIn("live_bytes_watermark", names)
+        for ev in counters:
+            self.assertIn("ts", ev)
+            for v in ev["args"].values():
+                self.assertIsInstance(v, int)
+
+    @unittest.skipUnless(fusion.active(), "fusion disabled via HEAT_TPU_FUSION")
+    def test_gate_decisions_land_on_timeline(self):
+        prev = telemetry.set_mode("verbose")
+        try:
+            memledger.set_budget(1, "warn")
+            a = self._split_input(14)
+            with warnings.catch_warnings(record=True):
+                warnings.simplefilter("always")
+                float((a + 0.5).sum())
+            gate_evs = [e for e in telemetry.events() if e["kind"] == "memory_gate"]
+            self.assertTrue(gate_evs)
+            self.assertTrue(gate_evs[0]["over"])
+            self.assertEqual(gate_evs[0]["policy"], "warn")
+        finally:
+            telemetry.set_mode(prev)
+
+
+class TestHealthMemoryReport(MemCase):
+    def test_report_shape_and_dedupe(self):
+        a = self._split_input(15)
+        a.parray
+        rep = health.memory_report()
+        self.assertGreater(rep["total_bytes"], 0)
+        self.assertEqual(rep["total_bytes"], sum(rep["per_device_bytes"].values()))
+        self.assertGreater(rep["buffer_count"], 0)
+        tops = [r["nbytes"] for r in rep["top_buffers"]]
+        self.assertEqual(tops, sorted(tops, reverse=True))
+        self.assertIn("owner", rep["top_buffers"][0])
+        # deduped: the mesh-filtered health total can never exceed the
+        # (deduped) global ledger total — double-counted shards would
+        self.assertLessEqual(rep["total_bytes"], memledger.ledger()["total_bytes"])
+
+    def test_deleted_buffers_skipped_without_blanket_except(self):
+        import jax
+
+        doomed = jax.device_put(np.ones((256,), dtype=np.float32))
+        before = health.memory_report()["total_bytes"]
+        doomed.delete()
+        rep = health.memory_report()  # must not raise on the deleted array
+        self.assertLessEqual(rep["total_bytes"], before)
+
+    def test_top_k_limit(self):
+        a = self._split_input(16)
+        a.parray
+        rep = health.memory_report(top=1)
+        self.assertLessEqual(len(rep["top_buffers"]), 1)
+
+
+class TestMemoryCLI(MemCase):
+    def test_live_memory_subcommand(self):
+        tcli = importlib.import_module("heat_tpu.telemetry")
+        a = self._split_input(17)
+        a.parray
+        out = io.StringIO()
+        rc = tcli.main(["memory", "--top", "2"], out=out)
+        self.assertEqual(rc, 0)
+        text = out.getvalue()
+        self.assertIn("live:", text)
+        self.assertIn("dndarray", text)
+
+    def test_memory_subcommand_from_report_file(self):
+        tcli = importlib.import_module("heat_tpu.telemetry")
+        a = self._split_input(18)
+        a.parray
+        memledger.sample("test", force=True)
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "report.json")
+            telemetry.report_json(path)
+            out = io.StringIO()
+            rc = tcli.main(["memory", path, "--json"], out=out)
+            self.assertEqual(rc, 0)
+            doc = json.loads(out.getvalue())
+            self.assertEqual(doc["source"], path)
+            self.assertIn("watermark", doc["memory"])
+            out = io.StringIO()
+            self.assertEqual(tcli.main(["memory", path], out=out), 0)
+            self.assertIn("memory (", out.getvalue())
+
+
+if __name__ == "__main__":
+    unittest.main()

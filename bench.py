@@ -650,6 +650,46 @@ def worker() -> None:
     except Exception:  # noqa: BLE001 - diagnostics must never cost the record
         pass
 
+    # distribution-flow verifier leg (heat_tpu/analysis/dataflow, ISSUE 9):
+    # the interprocedural abstract interpreter's wall time over the library +
+    # examples (the pre-merge budget a CI verify hook pays), its active
+    # finding count, and the static cost model's worst drift against
+    # telemetry-observed collective bytes on the drift workloads at the live
+    # mesh — the pin that keeps the op-table byte formulas honest against
+    # the runtime's declared schedules. Runs AFTER the record is banked
+    # (hang-safety invariant).
+    try:
+        from heat_tpu.analysis import dataflow as _dataflow
+
+        _repo = os.path.dirname(os.path.abspath(__file__))
+        start = time.perf_counter()
+        _vfind, _vstats = _dataflow.verify_paths(
+            [os.path.join(_repo, "heat_tpu"), os.path.join(_repo, "examples")],
+            mesh_size=ht.get_comm().size,
+        )
+        record["verify_ms"] = round((time.perf_counter() - start) * 1e3, 1)
+        record["verify_findings"] = sum(
+            1 for f in _vfind if not f.suppressed and not f.baselined
+        )
+        _drift = _dataflow.drift_report()
+        _pcts = [
+            rec["drift_pct"]
+            for rec in _drift["workloads"].values()
+            if rec["drift_pct"] is not None
+        ]
+        if _pcts and len(_pcts) == len(_drift["workloads"]):
+            record["verify_bytes_drift_pct"] = round(max(_pcts), 1)
+        if not all(rec["within_bound"] for rec in _drift["workloads"].values()):
+            # withheld-rather-than-mislabelled: name the drifting workloads
+            record["verify_drift_exceeded"] = sorted(
+                name
+                for name, rec in _drift["workloads"].items()
+                if not rec["within_bound"]
+            )
+        print(json.dumps(record), flush=True)  # last parseable line wins
+    except Exception:  # noqa: BLE001 - diagnostics must never cost the record
+        pass
+
     # checkpoint subsystem (utils/checkpoint.py): manifest-based sharded
     # save + verified restore of a trainer-shaped pytree (a split DNDarray
     # riding per-shard files + replicated param/opt leaves + scalars).

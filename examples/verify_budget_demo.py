@@ -1,0 +1,69 @@
+"""Distribution-flow verification demo: static cost budgets before running.
+
+The verifier (``python -m heat_tpu.analysis verify``) interprets Python
+source over the ``(rank, split, device-set, pending|forced)`` lattice and
+lower-bounds every region's bytes-on-wire BEFORE anything executes — so a
+CI gate can refuse an algorithm whose collective bill grew, without a mesh.
+
+This demo builds two versions of a centering pipeline, asks the verifier
+for their static cost, and shows a budget that passes on the sharded
+version and fails on the gather-everything version. Pure static analysis:
+the target sources below are never executed.
+
+Run:  python examples/verify_budget_demo.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from heat_tpu.analysis import dataflow
+
+MESH = 8
+
+SHARDED = """
+import heat_tpu as ht
+
+def center(n, f):
+    x = ht.random.randn(n, f, split=0)
+    return x - ht.mean(x, axis=0)   # psums one (f,) row: tiny
+
+y = center(65536, 64)
+"""
+
+GATHERING = """
+import heat_tpu as ht
+
+def center(n, f):
+    x = ht.random.randn(n, f, split=0)
+    g = ht.resplit(x, None)  # heat-lint: disable=S103 -- the demo's anti-pattern
+    return g - ht.mean(g, axis=0)
+
+y = center(65536, 64)
+"""
+
+
+def report(tag: str, src: str, budget_bytes: int) -> None:
+    findings, stats = dataflow.verify_source(
+        src, f"<{tag}>", mesh_size=MESH, budgets={"*center": budget_bytes}
+    )
+    region = stats["regions"].get(f"<{tag}>::center", {"bytes": 0, "cost": {}})
+    verdict = "OVER BUDGET" if any(f.rule == "S105" for f in findings) else "ok"
+    print(f"{tag:>9}: static lower bound {region['bytes']:>10} B  "
+          f"{dict(region['cost'])}  -> {verdict} (budget {budget_bytes} B)")
+
+
+def main() -> None:
+    print(f"static cost model at mesh {MESH} (nothing below is executed):")
+    budget = 1 << 20  # 1 MiB on the wire for the centering region
+    report("sharded", SHARDED, budget)
+    report("gathering", GATHERING, budget)
+    # the drift contract keeps the byte formulas honest against telemetry
+    static = dataflow.static_workload_bytes("qr_cholqr2", MESH)
+    print(f"drift workload qr_cholqr2 static estimate: {static} "
+          "(bench diffs this against telemetry-observed bytes, 2x bound)")
+
+
+if __name__ == "__main__":
+    main()

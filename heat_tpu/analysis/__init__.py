@@ -18,11 +18,24 @@ catches them statically, in two passes:
   ``fusion.program_costs`` machinery; nothing executes) and checked for
   replication blowups, collective-parity divergence across program
   variants, and declared bytes-on-wire budgets.
+* **Pass 3 — the distribution-flow verifier**
+  (:mod:`heat_tpu.analysis.dataflow`): an interprocedural abstract
+  interpreter over the ``(rank, split, device-set, pending|forced)``
+  lattice (:mod:`heat_tpu.analysis.lattice`), driven by a cross-module
+  call graph (:mod:`heat_tpu.analysis.callgraph`) with loop widening and
+  memoized per-function summaries. Rules S101-S105 catch the *semantic*
+  hazards the syntactic lint cannot: implicit reshards under
+  ``__binary_op``'s split dominance, blocking syncs and divergence hidden
+  behind helper calls, split downgrades, and static bytes-on-wire budget
+  violations — with a cost model drift-checked against telemetry's
+  observed collective bytes. Pure standard library, like the lint.
 
 ``python -m heat_tpu.analysis`` is the CLI (``lint`` / ``audit`` /
-``rules``); ``scripts/test_matrix.sh`` runs both as its analysis leg.
+``verify`` / ``rules``); ``scripts/test_matrix.sh`` runs all three passes
+as its analysis leg.
 """
 
+from .dataflow import drift_report, verify_paths, verify_source
 from .engine import (
     Finding,
     LintError,
@@ -45,12 +58,15 @@ __all__ = [
     "apply_baseline",
     "audit_programs",
     "baseline_entries",
+    "drift_report",
     "lint_paths",
     "lint_source",
     "load_baseline",
     "render_findings",
     "rule_table",
     "summarize",
+    "verify_paths",
+    "verify_source",
     "warm_bench_cache",
     "write_baseline",
 ]

@@ -248,8 +248,35 @@ def baseline_entries(findings: Iterable[Finding]) -> dict:
     return {"version": BASELINE_VERSION, "fingerprints": fps, "entries": entries}
 
 
-def write_baseline(path: str, findings: Iterable[Finding]) -> dict:
+def write_baseline(path: str, findings: Iterable[Finding], namespaces=None) -> dict:
+    """Write ``findings`` as the committed baseline. With ``namespaces``
+    (a tuple of rule-id prefixes, e.g. ``("S",)``), the write is scoped to
+    those namespaces: entries of OTHER namespaces already committed at
+    ``path`` are preserved verbatim, so the lint pass rewriting its H-rule
+    baseline never invalidates the dataflow pass's S-rule fingerprints and
+    vice versa (the two passes share one baseline file)."""
     doc = baseline_entries(findings)
+    if namespaces is not None:
+        prefixes = tuple(namespaces)
+        doc["entries"] = [e for e in doc["entries"] if e["rule"].startswith(prefixes)]
+        try:
+            old = load_baseline(path)
+        except LintError:
+            old = None
+        if old is not None:
+            kept = [
+                e
+                for e in old.get("entries", [])
+                if isinstance(e, dict) and not str(e.get("rule", "")).startswith(prefixes)
+            ]
+            doc["entries"] = kept + doc["entries"]
+        fps: Dict[str, int] = {}
+        for e in doc["entries"]:
+            fp = e.get("fingerprint")
+            if fp:
+                fps[fp] = fps.get(fp, 0) + 1
+        doc["fingerprints"] = fps
+        doc["entries"].sort(key=lambda e: (e.get("path", ""), e.get("line", 0), e.get("rule", "")))
     with open(path, "w") as fh:
         json.dump(doc, fh, indent=1, sort_keys=True)
         fh.write("\n")
@@ -300,7 +327,10 @@ def summarize(findings: Sequence[Finding]) -> dict:
 
 
 def render_findings(
-    findings: Sequence[Finding], show_suppressed: bool = False, hints: bool = True
+    findings: Sequence[Finding],
+    show_suppressed: bool = False,
+    hints: bool = True,
+    prog: str = "heat-lint",
 ) -> str:
     """Human-readable report: one ``path:line: RULE severity: message`` block
     per active finding (suppressed/baselined shown only on request), ending
@@ -317,7 +347,7 @@ def render_findings(
             out.append(f"    hint: {f.hint}")
     s = summarize(findings)
     out.append(
-        f"heat-lint: {s['active']} finding(s) ({s['errors']} error(s), "
+        f"{prog}: {s['active']} finding(s) ({s['errors']} error(s), "
         f"{s['warnings']} warning(s)) in {s['files']} file(s); "
         f"{s['suppressed']} suppressed, {s['baselined']} baselined"
     )

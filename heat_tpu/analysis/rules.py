@@ -32,7 +32,12 @@ aliases of the ``heat_tpu`` namespace, run a small per-function taint pass
 (H001: host-divergent values; H002: heat-produced values) and otherwise
 require syntactic evidence. Anything cleverer belongs in the program auditor
 (:mod:`heat_tpu.analysis.audit`), which reasons about the *compiled*
-artifact instead of the source.
+artifact instead of the source, or in the distribution-flow verifier
+(:mod:`heat_tpu.analysis.dataflow`, rules S101-S105), which interprets the
+source *semantically* — interprocedurally, over the split lattice — and
+reuses this module's syntactic vocabulary (:func:`dotted_name`,
+:func:`_divergent_call`, :func:`_is_collective_call`) so the two passes
+agree on what a divergence source and a collective call look like.
 """
 
 from __future__ import annotations

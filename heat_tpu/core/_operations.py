@@ -70,6 +70,38 @@ def __binary_op(
     ref = t1 if isinstance(t1, DNDarray) else t2
     comm, device = ref.comm, ref.device
 
+    # implicit-reshard-made-explicit (heat-verify S101): identical-shape
+    # operands on DIFFERENT split axes would otherwise be resharded by XLA
+    # inside the op itself — a collective invisible to telemetry, the fault
+    # registry and the fusion DAG. Route the non-dominant operand through
+    # the explicit resplit seam instead: the redistribution records as a
+    # DAG node (fusion.defer_reshard) or an eager reshard, fires its
+    # collective.reshard fault site, and banks its logical bytes in the
+    # collective ledger as a "reshard". Broadcasted (different-shape)
+    # combinations keep XLA's behavior — the static verifier still flags
+    # both.
+    if (
+        isinstance(t1, DNDarray)
+        and isinstance(t2, DNDarray)
+        and t1.split is not None
+        and t2.split is not None
+        and t1.split != t2.split
+        and t1.shape == t2.shape
+    ):
+        from .manipulations import resplit as _explicit_resplit
+
+        if telemetry._MODE:
+            # the collective.reshard fault site fires inside _explicit_resplit
+            # heat-lint: disable=H005 — one dispatch, one site (in resplit below)
+            telemetry.record_collective(
+                "reshard",
+                comm.axis_name,
+                int(np.prod(t2.shape, dtype=np.int64))
+                * np.dtype(t2.dtype.jax_type()).itemsize,
+                str(t2.dtype),
+            )
+        t2 = _explicit_resplit(t2, t1.split)
+
     # dtype promotion (reference _operations.py:87): operands are cast to the
     # promoted type BEFORE the op so op-induced promotion (e.g. true_divide of
     # integers -> float) is preserved rather than clobbered afterwards.

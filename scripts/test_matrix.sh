@@ -80,12 +80,16 @@ HEAT_TPU_FAULTS=ci HEAT_TPU_TELEMETRY=1 \
     tests/test_memory_obs.py -q -x
 # static-analysis leg (heat_tpu/analysis): the AST lint must be clean
 # against the committed baseline (zero NEW findings — suppressions carry
-# their justifications inline), and the AOT program auditor over a cache
+# their justifications inline), the AOT program auditor over a cache
 # warmed with the bench-shaped workloads at mesh 8 must report zero
-# replication-blowup / collective-parity / budget findings
-echo "=== static analysis (heat-lint + program audit) ==="
+# replication-blowup / collective-parity / budget findings, and the
+# distribution-flow verifier (interprocedural split/sharding abstract
+# interpretation, rules S101-S105) must verify the library + examples
+# clean against the same (namespace-shared) baseline
+echo "=== static analysis (heat-lint + program audit + heat-verify) ==="
 python -m heat_tpu.analysis lint heat_tpu examples --baseline heat-lint-baseline.json
 python -m heat_tpu.analysis audit --warm bench --devices 8
+python -m heat_tpu.analysis verify heat_tpu examples --baseline heat-lint-baseline.json
 # the coverage gate (reference codecov.yml target semantics): the merged
 # matrix coverage must clear the floor or the matrix run fails. On runtimes
 # without sys.monitoring (Python < 3.12) no cov_mesh*.json legs are produced

@@ -19,6 +19,7 @@ SMOKE_SCRIPTS = [
     "daso_training",
     "long_context_lm",
     "compiled_pipeline",
+    "verify_budget_demo",
 ]
 
 
@@ -38,6 +39,9 @@ def test_example_runs(script, capsys):
         assert err < 1e-2
     if script == "svd_pca":
         assert "explain" in out  # its own assert enforces >95% in 3 components
+    if script == "verify_budget_demo":
+        assert "OVER BUDGET" in out  # the gather anti-pattern must be caught
+        assert "-> ok" in out  # and the sharded version must pass
 
 
 def test_every_example_is_smoke_covered():

@@ -19,6 +19,7 @@ from .core import (
     devices,
     exponential,
     factories,
+    health_runtime,
     indexing,
     io,
     logical,
@@ -40,6 +41,10 @@ from .core import (
     version,
 )
 from .core.version import __version__
+
+#: the runtime health layer's short name: ``ht.flight.dump_flight()``,
+#: ``ht.flight.watch(...)``, ``ht.flight.health_block()``
+flight = health_runtime
 
 
 def _bind_dndarray_methods():

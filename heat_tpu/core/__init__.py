@@ -20,6 +20,7 @@ from . import telemetry
 from . import resilience
 from .resilience import errstate
 from . import memledger
+from . import health_runtime
 from . import fusion
 from .dndarray import *
 from .factories import *

@@ -44,7 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import communication as comm_module
-from . import devices, fusion, memledger, resilience, telemetry, types
+from . import devices, fusion, health_runtime, memledger, resilience, telemetry, types
 from .communication import Communication, MeshCommunication
 from .stride_tricks import sanitize_axis
 
@@ -645,7 +645,10 @@ class DNDarray:
         """Gather the global (logical) array to host numpy (reference
         dndarray.py:991-1003); padding never leaves the device."""
         token = self._note_blocking_sync("numpy")
-        out = np.asarray(jax.device_get(self.larray))
+        with health_runtime.watch(
+            "sync:numpy", cid=None if token is None else token.get("cid")
+        ):
+            out = np.asarray(jax.device_get(self.larray))
         telemetry.end_blocking_sync(token)
         return out
 
@@ -658,7 +661,10 @@ class DNDarray:
         if self.size != 1:
             raise ValueError("only one-element DNDarrays can be converted to Python scalars")
         token = self._note_blocking_sync("item")
-        out = self.larray.item()
+        with health_runtime.watch(
+            "sync:item", cid=None if token is None else token.get("cid")
+        ):
+            out = self.larray.item()
         telemetry.end_blocking_sync(token)
         return out
 

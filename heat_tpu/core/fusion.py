@@ -102,6 +102,7 @@ import functools
 import hashlib
 import itertools
 import os
+import time
 import weakref
 from collections import OrderedDict
 from contextlib import contextmanager
@@ -111,7 +112,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import memledger, resilience, telemetry
+from . import health_runtime, memledger, resilience, telemetry
 
 __all__ = [
     "LazyArray",
@@ -668,7 +669,8 @@ def _drain_pending_roots(exclude=()):
                 token = None
                 if telemetry._MODE:
                     token = telemetry.record_blocking_sync("drain", cid=payload.cid)
-                value.block_until_ready()
+                with health_runtime.watch("sync:drain", cid=payload.cid):
+                    value.block_until_ready()
                 # close the event so the trace shows the drain's true host
                 # wait as a duration, not a zero-width instant
                 telemetry.end_blocking_sync(token)
@@ -710,6 +712,9 @@ def _degrade(sig, leaves, exc, missed):
         ),
         stacklevel=4,
     )
+    # black-box the failure: the ring holds the dispatches/collectives that
+    # led here (throttled; no-op when the recorder is disarmed)
+    health_runtime.auto_dump("degrade")
     return _build(sig)(*leaves)
 
 
@@ -776,11 +781,12 @@ def force(node):
                 _STATS["evictions"] += 1
             if telemetry._MODE:
                 telemetry.record_retrace(_family(sig), _leaf_key(sig))
-                if telemetry._MODE >= 2:
-                    telemetry.record_event(
-                        "compile",
-                        program=info["key"], family=info["family"], cid=node.cid,
-                    )
+                # lands on the verbose timeline AND the flight ring (the
+                # black box wants compiles next to the dispatches they cost)
+                telemetry.record_event(
+                    "compile",
+                    program=info["key"], family=info["family"], cid=node.cid,
+                )
         else:
             _PROGRAMS.move_to_end(sig)
             _STATS["hits"] += 1
@@ -816,7 +822,23 @@ def force(node):
                 # (ISSUE 8): fires the same seam a real RESOURCE_EXHAUSTED
                 # would, so the forensic + degrade path is testable
                 resilience.check("memory.exhausted")
-            values = prog(*leaves)
+            if telemetry._MODE or health_runtime._WD_ACTIVE:
+                # the fused-dispatch arming point: the watchdog knows the
+                # in-flight program key + batched root cids at arm time, and
+                # the health layer starts the dispatch→done clock (a fresh
+                # build's call duration is the compile-time sample)
+                cids = [r.cid for r in roots]
+                t_disp = time.perf_counter()
+                with health_runtime.watch(
+                    "dispatch", program=info["key"], cid=node.cid, cids=cids
+                ):
+                    values = prog(*leaves)
+                if telemetry._MODE:
+                    health_runtime.note_dispatch(
+                        info["key"], cids, missed, time.perf_counter() - t_disp
+                    )
+            else:
+                values = prog(*leaves)
             info["dispatches"] += 1
             info["roots"] += len(roots)
         except Exception as exc:  # noqa: BLE001 - routed through ONE policy

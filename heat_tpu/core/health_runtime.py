@@ -1,0 +1,888 @@
+"""Runtime health layer (``ht.flight``): always-on flight recorder, stall
+watchdog, and streaming latency histograms with rolling SLO gauges.
+
+Telemetry (``core/telemetry.py``) explains a run after the fact; this module
+watches the runtime WHILE it serves — the black box a production job is
+examined by when it hangs, OOMs, or quietly misses its latency target:
+
+* **Flight recorder** — a fixed-size ring of the compact typed events
+  (dispatches, blocking syncs, collectives, compiles, faults, degradations,
+  OOMs) captured even at plain ``HEAT_TPU_TELEMETRY=1``, where the verbose
+  per-state timelines stay empty: ``telemetry._note_event`` hands every
+  event to ``_FLIGHT_HOOK`` (the set-attribute seam, same pattern as the
+  memledger's ``_MEM_HOOK``) and the ring costs one deque append. On OOM,
+  fault-degrade, a watchdog trip, or an explicit :func:`dump_flight`, the
+  ring is exported as a Chrome/Perfetto trace (validated through
+  ``telemetry.validate_trace``) next to a JSON forensics bundle: watchdog
+  state, stall diagnoses, latency histograms, fusion-cache metadata, memory
+  watermark and the stored OOM report. Knobs: ``HEAT_TPU_FLIGHT={0,1}``,
+  ``HEAT_TPU_FLIGHT_EVENTS=N``, ``HEAT_TPU_FLIGHT_DIR``,
+  ``HEAT_TPU_FLIGHT_DUMP_EVERY_S`` (per-reason auto-dump throttle).
+
+* **Stall watchdog** — a lazily started daemon thread monitoring every
+  armed :func:`watch` guard (fused dispatches in ``fusion.force``, the
+  blocking host boundaries in ``dndarray.numpy/item``, deferred prints, and
+  the admission gate's drain loop). A guard still in flight past its
+  deadline (``HEAT_TPU_WATCHDOG_MS``) produces a structured stall diagnosis:
+  the in-flight program key, the pending DAG root cids, the recent
+  collective trail from the flight ring (this host's half of the cross-host
+  parity comparison — the runtime complement of lint rules H001/S104), and
+  the blocked thread's stack. Policies (``HEAT_TPU_WATCHDOG_POLICY``):
+  ``warn`` emits a :class:`~heat_tpu.core.resilience.StallWarning`;
+  ``dump`` also auto-dumps the flight ring; ``raise`` additionally raises
+  :class:`~heat_tpu.core.resilience.StallError` at the guarded call site
+  once it returns (a policy signal — ``force_recoverable`` never degrades
+  it). The ``watchdog.stall`` fault site lets tests inject REAL stalls: an
+  armed guard converts the injected fault into sleeping past its own
+  deadline, so the daemon trips on its own clock.
+
+* **Latency histograms + SLO gauges** — log-bucketed (HDR-style, ~9%
+  relative error) streaming histograms for blocking-sync host wait (per
+  trigger), dispatch→done (per program key), and fused-program compile time
+  (per program key), kept per scope exactly like telemetry's counter states
+  (record to every state on the stack, query the innermost) and surfaced
+  with p50/p90/p99 in ``report()["health"]``, the metrics sink, and
+  ``python -m heat_tpu.telemetry health``. Optional SLO limits
+  (``HEAT_TPU_SLO_SYNC_MS`` / ``_DISPATCH_MS`` / ``_COMPILE_MS``) turn each
+  observation into a pass/breach sample over a rolling window
+  (``HEAT_TPU_SLO_WINDOW_S``); breaches land on the flight ring.
+
+Contracts inherited from the rest of the observability stack: nothing here
+ever forces a pending chain or initializes a JAX backend (pure module state
+plus metadata reads), everything stays inside the ≥0.9x dispatch-rate
+overhead guard, and ``telemetry.reset()``/``scope()`` reset/scope this
+module's session state too (the joined-surface rule PR 8 established for
+the memory ledger).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import math
+import os
+import sys
+import tempfile
+import threading
+import time
+import traceback
+import warnings
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import resilience, telemetry
+from .resilience import StallError, StallWarning
+
+__all__ = [
+    "StallError",
+    "StallWarning",
+    "auto_dump",
+    "dump_flight",
+    "flight_events",
+    "flight_stats",
+    "health_block",
+    "last_dump",
+    "last_stall",
+    "note_dispatch",
+    "reset",
+    "set_dump_dir",
+    "set_flight",
+    "set_slo",
+    "set_watchdog",
+    "stalls",
+    "watch",
+    "watchdog_stats",
+]
+
+_OFF_VALUES = ("", "0", "false", "off", "no")
+_UNSET = object()
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return float(raw.strip())
+    except ValueError:
+        warnings.warn(
+            f"{name}: malformed value {raw!r}; using {default}", stacklevel=2
+        )
+        return default
+
+
+def _env_ms(name: str) -> Optional[float]:
+    """Optional millisecond knob returned in SECONDS (None = unset)."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return None
+    try:
+        return float(raw.strip()) / 1e3
+    except ValueError:
+        warnings.warn(f"{name}: malformed value {raw!r}; ignored", stacklevel=2)
+        return None
+
+
+# ----------------------------------------------------------------------
+# flight recorder: the always-on event ring
+# ----------------------------------------------------------------------
+_ENABLED = os.environ.get("HEAT_TPU_FLIGHT", "1").strip().lower() not in _OFF_VALUES
+_RING_CAP = max(16, int(_env_float("HEAT_TPU_FLIGHT_EVENTS", 2048)))
+_RING: deque = deque(maxlen=_RING_CAP)
+_RING_DROPPED = 0
+_DUMP_DIR = os.environ.get("HEAT_TPU_FLIGHT_DIR", "").strip() or tempfile.gettempdir()
+_DUMP_EVERY_S = max(0.0, _env_float("HEAT_TPU_FLIGHT_DUMP_EVERY_S", 60.0))
+_DUMP_COUNT = 0
+_LAST_DUMP: Optional[Dict[str, Any]] = None
+_LAST_AUTO_DUMP_TS: Dict[str, float] = {}
+
+
+def _flight_note(ev: dict) -> None:
+    """The ``telemetry._FLIGHT_HOOK``: one bounded append per typed event.
+    The ring shares the event dict with the verbose timeline, so late
+    ``dur`` stamps (closed blocking syncs) show up in dumps too."""
+    global _RING_DROPPED
+    if len(_RING) == _RING.maxlen:
+        _RING_DROPPED += 1
+    _RING.append(ev)
+
+
+def _install_hook() -> None:
+    telemetry._FLIGHT_HOOK = _flight_note if _ENABLED else None
+
+
+def set_flight(enabled: Optional[bool] = None, events: Optional[int] = None):
+    """Toggle the flight recorder / resize its ring in-process (tests, bench
+    legs). Returns the previous ``(enabled, ring_cap)`` pair. Resizing keeps
+    the newest events."""
+    global _ENABLED, _RING_CAP, _RING
+    prev = (_ENABLED, _RING_CAP)
+    if enabled is not None:
+        _ENABLED = bool(enabled)
+    if events is not None:
+        _RING_CAP = max(1, int(events))
+        _RING = deque(_RING, maxlen=_RING_CAP)
+    _install_hook()
+    return prev
+
+
+def flight_events() -> List[dict]:
+    """The current ring contents, oldest first."""
+    return list(_RING)
+
+
+def flight_stats() -> Dict[str, Any]:
+    """Ring occupancy + dump accounting — the report()/CLI surface."""
+    return {
+        "enabled": _ENABLED,
+        "events": len(_RING),
+        "cap": _RING_CAP,
+        "dropped": _RING_DROPPED,
+        "dumps": _DUMP_COUNT,
+        "last_dump": (_LAST_DUMP or {}).get("path"),
+    }
+
+
+def set_dump_dir(path: str) -> str:
+    """Redirect auto-dumps (tests, servers with a scratch volume); returns
+    the previous directory."""
+    global _DUMP_DIR
+    prev, _DUMP_DIR = _DUMP_DIR, str(path)
+    return prev
+
+
+def last_dump() -> Optional[Dict[str, Any]]:
+    """The most recent dump's ``{"path", "trace_path", "problems"}``."""
+    return _LAST_DUMP
+
+
+def dump_flight(path: Optional[str] = None, reason: str = "manual") -> Dict[str, Any]:
+    """Export the flight ring as a validated Chrome/Perfetto trace
+    (``<base>.trace.json``) plus a JSON forensics bundle (``<base>.json``):
+    watchdog state and stall diagnoses, the latency/SLO picture, fusion
+    program-cache metadata, and the memory watermark + stored OOM report.
+    Pure module state and metadata reads — never forces a pending chain,
+    never initializes a backend. Returns ``{"path", "trace_path",
+    "problems"}`` (``problems`` is ``validate_trace``'s finding list —
+    empty for a well-formed dump)."""
+    global _DUMP_COUNT, _LAST_DUMP
+    evs = list(_RING)
+    _DUMP_COUNT += 1
+    if path is None:
+        base = os.path.join(
+            _DUMP_DIR,
+            f"heat_flight_h{telemetry._host_index()}_{reason}_{_DUMP_COUNT:03d}",
+        )
+    else:
+        base = path[:-5] if path.endswith(".json") else path
+    trace_path = base + ".trace.json"
+    bundle_path = base + ".json"
+    doc = telemetry.export_trace(trace_path, events=evs)
+    problems = [str(p) for p in telemetry.validate_trace(doc)]
+    bundle: Dict[str, Any] = {
+        "reason": reason,
+        "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "host": telemetry._host_index(),
+        "telemetry_mode": telemetry._MODE,
+        "events": len(evs),
+        "events_dropped": _RING_DROPPED,
+        "ring_cap": _RING_CAP,
+        "trace_path": trace_path,
+        "trace_problems": problems,
+        "collective_parity_problems": [
+            str(p) for p in telemetry.trace_collective_parity(doc)
+        ],
+        "watchdog": watchdog_stats(),
+        "stalls": list(_STALLS),
+        "health": health_block(global_view=True),
+    }
+    try:
+        from . import fusion
+
+        bundle["programs"] = fusion.cache_stats()
+    except Exception as exc:  # half-imported runtime: dump what exists
+        bundle["programs"] = {"error": repr(exc)}
+    try:
+        from . import memledger
+
+        bundle["memory"] = {
+            "watermark": memledger.watermark(),
+            "budget": memledger.budget_info(),
+            "last_oom": memledger.last_oom(),
+        }
+    except Exception as exc:
+        bundle["memory"] = {"error": repr(exc)}
+    with open(bundle_path, "w") as fh:
+        json.dump(telemetry._jsonable(bundle), fh, indent=1, default=str)
+        fh.write("\n")
+    _LAST_DUMP = {"path": bundle_path, "trace_path": trace_path, "problems": problems}
+    telemetry.record_event(
+        "flight_dump", reason=reason, path=bundle_path, events=len(evs)
+    )
+    return dict(_LAST_DUMP)
+
+
+def auto_dump(reason: str) -> Optional[Dict[str, Any]]:
+    """The crash-dump trigger wired at the failure seams (memledger OOM,
+    fusion degrade, watchdog trip). Throttled per reason
+    (``HEAT_TPU_FLIGHT_DUMP_EVERY_S``) so a degrade storm writes one bundle,
+    not thousands; a no-op unless the recorder is enabled and telemetry is
+    active (an empty ring has nothing to explain)."""
+    if not _ENABLED or not telemetry._MODE:
+        return None
+    now = time.perf_counter()
+    last = _LAST_AUTO_DUMP_TS.get(reason)
+    if last is not None and _DUMP_EVERY_S > 0 and now - last < _DUMP_EVERY_S:
+        return None
+    _LAST_AUTO_DUMP_TS[reason] = now
+    try:
+        return dump_flight(reason=reason)
+    except Exception as exc:  # the black box must never take down recovery
+        warnings.warn(f"flight auto-dump ({reason}) failed: {exc!r}", stacklevel=2)
+        return None
+
+
+# ----------------------------------------------------------------------
+# streaming latency histograms (log-bucketed, HDR-style)
+# ----------------------------------------------------------------------
+#: bucket growth factor: 2**(1/8) ≈ 1.09 — ≤ ~9% relative quantile error
+_HIST_BASE = 2.0 ** 0.125
+_HIST_LOG = math.log(_HIST_BASE)
+_HIST_FLOOR = 1e-9  # sub-nanosecond waits clamp into the first bucket
+
+
+class _Hist:
+    """One streaming histogram over seconds: sparse log-spaced buckets plus
+    exact count/total/min/max. Quantiles walk the cumulative counts and
+    return the bucket's geometric midpoint clamped to the observed range —
+    bounded relative error at O(1) memory, however long the run."""
+
+    __slots__ = ("count", "total", "vmin", "vmax", "buckets")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.vmin = math.inf
+        self.vmax = 0.0
+        self.buckets: Dict[int, int] = {}
+
+    def observe(self, v: float) -> None:
+        v = _HIST_FLOOR if v <= _HIST_FLOOR else float(v)
+        idx = int(math.floor(math.log(v) / _HIST_LOG))
+        self.buckets[idx] = self.buckets.get(idx, 0) + 1
+        self.count += 1
+        self.total += v
+        if v < self.vmin:
+            self.vmin = v
+        if v > self.vmax:
+            self.vmax = v
+
+    def merge(self, other: "_Hist") -> None:
+        for idx, n in other.buckets.items():
+            self.buckets[idx] = self.buckets.get(idx, 0) + n
+        self.count += other.count
+        self.total += other.total
+        self.vmin = min(self.vmin, other.vmin)
+        self.vmax = max(self.vmax, other.vmax)
+
+    def percentile(self, q: float) -> float:
+        if not self.count:
+            return 0.0
+        target = q / 100.0 * self.count
+        cum = 0
+        for idx in sorted(self.buckets):
+            cum += self.buckets[idx]
+            if cum >= target:
+                rep = _HIST_BASE ** (idx + 0.5)
+                return min(max(rep, self.vmin), self.vmax)
+        return self.vmax
+
+    def snapshot(self) -> Dict[str, Any]:
+        if not self.count:
+            return {"count": 0}
+        return {
+            "count": self.count,
+            "total_s": round(self.total, 6),
+            "mean_s": round(self.total / self.count, 6),
+            "min_s": round(self.vmin, 9),
+            "max_s": round(self.vmax, 6),
+            "p50_s": round(self.percentile(50.0), 6),
+            "p90_s": round(self.percentile(90.0), 6),
+            "p99_s": round(self.percentile(99.0), 6),
+        }
+
+
+#: distinct per-program rows kept per table (LRU; the "*" overall row is a
+#: separate slot and never evicted)
+_PROGRAM_CAP = 64
+_METRICS = ("sync", "dispatch", "compile")
+
+
+class _HState:
+    """One scope's histogram tables — mirrors ``telemetry._State``: record
+    to every state on the stack, query the innermost."""
+
+    __slots__ = ("path", "overall", "sync", "dispatch", "compile")
+
+    def __init__(self, path: str = ""):
+        self.path = path
+        self.clear()
+
+    def clear(self) -> None:
+        self.overall: Dict[str, _Hist] = {m: _Hist() for m in _METRICS}
+        self.sync: Dict[str, _Hist] = {}
+        self.dispatch: "OrderedDict[str, _Hist]" = OrderedDict()
+        self.compile: "OrderedDict[str, _Hist]" = OrderedDict()
+
+
+def _merge_hstate(dst: _HState, src: _HState) -> None:
+    for m in _METRICS:
+        dst.overall[m].merge(src.overall[m])
+        table, out = getattr(src, m), getattr(dst, m)
+        for key, h in table.items():
+            acc = out.get(key)
+            if acc is None:
+                acc = out[key] = _Hist()
+            acc.merge(h)
+
+
+_H_GLOBAL = _HState()
+_H_STATES: List[_HState] = [_H_GLOBAL]
+#: completed-scope accumulators, keyed by scope path (re-entry accumulates)
+_H_SCOPES: Dict[str, _HState] = {}
+
+
+def _push_scope(path: str) -> None:
+    """``telemetry.scope`` seam: scope the histograms alongside the counters."""
+    _H_STATES.append(_HState(path))
+
+
+def _pop_scope(path: str) -> None:
+    for i in range(len(_H_STATES) - 1, 0, -1):  # never pop the global state
+        if _H_STATES[i].path == path:
+            st = _H_STATES.pop(i)
+            acc = _H_SCOPES.get(path)
+            if acc is None:
+                acc = _H_SCOPES[path] = _HState(path)
+            _merge_hstate(acc, st)
+            return
+
+
+def _observe(metric: str, key: Optional[str], v: float) -> None:
+    """Fold one latency sample into every active state ('*' overall row +
+    the per-key row) and the SLO window. ``key`` is the sync trigger or the
+    program key (None observes the overall row only)."""
+    for st in _H_STATES:
+        st.overall[metric].observe(v)
+        if key is None:
+            continue
+        table = getattr(st, metric)
+        h = table.get(key)
+        if h is None:
+            h = table[key] = _Hist()
+            if metric != "sync":
+                while len(table) > _PROGRAM_CAP:
+                    table.popitem(last=False)
+        elif metric != "sync":
+            table.move_to_end(key)
+        h.observe(v)
+    _slo_observe(metric, v)
+
+
+def _render_hists(st: _HState, metric: str) -> Dict[str, Any]:
+    out = {"*": st.overall[metric].snapshot()}
+    for key, h in getattr(st, metric).items():
+        out[str(key)] = h.snapshot()
+    return out
+
+
+# ----------------------------------------------------------------------
+# SLO gauges: rolling pass/breach windows per metric
+# ----------------------------------------------------------------------
+_SLO_LIMITS: Dict[str, Optional[float]] = {  # seconds; None = no SLO set
+    "sync": _env_ms("HEAT_TPU_SLO_SYNC_MS"),
+    "dispatch": _env_ms("HEAT_TPU_SLO_DISPATCH_MS"),
+    "compile": _env_ms("HEAT_TPU_SLO_COMPILE_MS"),
+}
+_SLO_WINDOW_S = max(1.0, _env_float("HEAT_TPU_SLO_WINDOW_S", 300.0))
+_SLO_SAMPLES: Dict[str, deque] = {m: deque(maxlen=2048) for m in _METRICS}
+_SLO_BREACHES: Dict[str, int] = {m: 0 for m in _METRICS}
+
+
+def _slo_observe(metric: str, v: float) -> None:
+    now = time.perf_counter()
+    _SLO_SAMPLES[metric].append((now, v))
+    limit = _SLO_LIMITS.get(metric)
+    if limit is not None and v > limit:
+        _SLO_BREACHES[metric] += 1
+        telemetry.record_event(
+            "slo_breach",
+            metric=metric,
+            value_ms=round(v * 1e3, 3),
+            limit_ms=round(limit * 1e3, 3),
+        )
+
+
+def set_slo(
+    sync_ms=_UNSET, dispatch_ms=_UNSET, compile_ms=_UNSET, window_s=None
+) -> Dict[str, Optional[float]]:
+    """Set SLO limits in-process (None clears one); returns the previous
+    limits in seconds keyed by metric."""
+    prev = dict(_SLO_LIMITS)
+    for metric, value in (
+        ("sync", sync_ms), ("dispatch", dispatch_ms), ("compile", compile_ms)
+    ):
+        if value is not _UNSET:
+            _SLO_LIMITS[metric] = None if value is None else float(value) / 1e3
+    if window_s is not None:
+        global _SLO_WINDOW_S
+        _SLO_WINDOW_S = max(1.0, float(window_s))
+    return prev
+
+
+def _slo_block() -> Dict[str, Any]:
+    now = time.perf_counter()
+    out: Dict[str, Any] = {"window_s": _SLO_WINDOW_S}
+    for metric, dq in _SLO_SAMPLES.items():
+        limit = _SLO_LIMITS[metric]
+        vals = sorted(v for ts, v in dq if now - ts <= _SLO_WINDOW_S)
+        entry: Dict[str, Any] = {
+            "limit_ms": None if limit is None else round(limit * 1e3, 3),
+            "recent": len(vals),
+            "breaches_total": _SLO_BREACHES[metric],
+        }
+        if vals:
+            def pct(q):
+                return vals[min(len(vals) - 1, int(q / 100.0 * len(vals)))]
+
+            entry["window_p50_ms"] = round(pct(50) * 1e3, 3)
+            entry["window_p99_ms"] = round(pct(99) * 1e3, 3)
+            if limit is not None:
+                bad = sum(1 for v in vals if v > limit)
+                entry["window_breaches"] = bad
+                entry["ok_ratio"] = round(1.0 - bad / len(vals), 4)
+        out[metric] = entry
+    return out
+
+
+# ----------------------------------------------------------------------
+# dispatch→done resolution
+# ----------------------------------------------------------------------
+#: in-flight dispatches: cid -> (dispatch perf_counter ts, program key).
+#: A blocking sync closing on one of these cids resolves the sample; the
+#: LRU cap bounds fire-and-forget dispatches whose reads never block.
+_DISPATCHED: "OrderedDict[int, Tuple[float, str]]" = OrderedDict()
+_DISPATCHED_CAP = 512
+
+
+def note_dispatch(program: str, cids, compiled: bool, dur_s: float) -> None:
+    """``fusion.force`` seam, called right after the program call returns:
+    start the dispatch→done clock for every batched root cid, and fold the
+    call's duration into the per-program compile histogram when this force
+    paid a fresh build (on a cache hit the call is an async enqueue — its
+    duration measures nothing worth keeping)."""
+    now = time.perf_counter()
+    for cid in cids:
+        if cid is not None:
+            _DISPATCHED[cid] = (now - dur_s, str(program))
+            _DISPATCHED.move_to_end(cid)
+    while len(_DISPATCHED) > _DISPATCHED_CAP:
+        _DISPATCHED.popitem(last=False)
+    if compiled:
+        _observe("compile", str(program), dur_s)
+
+
+def _on_sync_end(kind: str, cid: Optional[int], dur: float) -> None:
+    """``telemetry._SYNC_HOOK``: every closed blocking sync feeds the host
+    wait histogram, and — when its cid matches an in-flight dispatch — the
+    dispatch→done histogram under that program key."""
+    now = time.perf_counter()
+    _observe("sync", kind, dur)
+    if cid is not None:
+        rec = _DISPATCHED.pop(cid, None)
+        if rec is not None:
+            _observe("dispatch", rec[1], max(0.0, now - rec[0]))
+
+
+# ----------------------------------------------------------------------
+# stall watchdog
+# ----------------------------------------------------------------------
+_WD_ENABLED = (
+    os.environ.get("HEAT_TPU_WATCHDOG", "1").strip().lower() not in _OFF_VALUES
+)
+_WD_DEADLINE_S = max(0.0, _env_float("HEAT_TPU_WATCHDOG_MS", 30000.0)) / 1e3
+_WD_POLICIES = ("warn", "dump", "raise")
+_WD_POLICY = os.environ.get("HEAT_TPU_WATCHDOG_POLICY", "warn").strip().lower() or "warn"
+if _WD_POLICY not in _WD_POLICIES:
+    warnings.warn(
+        f"HEAT_TPU_WATCHDOG_POLICY: unknown policy {_WD_POLICY!r}; using 'warn'",
+        stacklevel=2,
+    )
+    _WD_POLICY = "warn"
+#: one attribute read decides the disarmed hot path
+_WD_ACTIVE = _WD_ENABLED and _WD_DEADLINE_S > 0
+
+_WD_COND = threading.Condition(threading.Lock())
+_WD_GUARDS: Dict[int, "_Guard"] = {}
+_WD_SEQ = itertools.count(1)  # atomic idents without a lock on the arm path
+_WD_THREAD: Optional[threading.Thread] = None
+_WD_STATS = {"arms": 0, "trips": 0}
+_STALLS: deque = deque(maxlen=16)
+
+
+class _NullGuard:
+    """The disarmed fast path: a shared no-op context manager."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_GUARD = _NullGuard()
+
+
+class _Guard:
+    """One armed watch over a blocking region. The daemon trips it past its
+    deadline; the guarded thread raises at exit under the ``raise`` policy
+    (the daemon itself can only warn/dump — it must never throw into code
+    it does not own)."""
+
+    __slots__ = (
+        "ident", "site", "deadline_s", "t0", "program", "cid", "cids",
+        "thread_ident", "tripped",
+    )
+
+    def __init__(self, site, deadline_s, program, cid, cids):
+        self.ident = next(_WD_SEQ)
+        self.site = site
+        self.deadline_s = deadline_s
+        self.program = program
+        self.cid = cid
+        self.cids = tuple(cids)
+        self.t0 = 0.0
+        self.thread_ident = 0
+        self.tripped = False
+
+    def __enter__(self) -> "_Guard":
+        self.t0 = time.perf_counter()
+        self.thread_ident = threading.get_ident()
+        # lock-free arm: dict set/pop are GIL-atomic and the daemon scans a
+        # snapshot — the condition lock is only paid on the rare paths
+        # (thread bring-up, short test-scale deadlines that must not ride
+        # the 0.5s poll)
+        _WD_GUARDS[self.ident] = self
+        _WD_STATS["arms"] += 1
+        if _WD_THREAD is None or not _WD_THREAD.is_alive():
+            with _WD_COND:
+                _ensure_thread()
+        if self.deadline_s < 2.0:
+            # short (test-scale) deadlines wake the daemon immediately;
+            # production-scale ones ride its 0.5s poll granularity
+            with _WD_COND:
+                _WD_COND.notify()
+        if resilience._ARMED:
+            # the injectable stall: convert the fault into REALLY blocking
+            # past this guard's deadline, so the daemon trips on its own
+            # clock — tests exercise detection, not a mock of it. The bare
+            # site stalls whichever guard arms first; the site-qualified one
+            # (e.g. ``watchdog.stall:dispatch``) targets one arming point
+            try:
+                resilience.check("watchdog.stall")
+                resilience.check("watchdog.stall:" + str(self.site))
+            except resilience.FaultInjected:
+                time.sleep(self.deadline_s * 1.5 + 0.05)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        _WD_GUARDS.pop(self.ident, None)
+        if self.tripped and exc_type is None and _WD_POLICY == "raise":
+            raise StallError(
+                f"{self.site} blocked past its {self.deadline_s:.3f}s watchdog "
+                f"deadline (program {self.program or '<unknown>'}); full "
+                "diagnosis via health_runtime.last_stall()"
+            )
+        return False
+
+
+def watch(site: str, program=None, cid=None, cids=(), deadline_ms=None):
+    """Arm the watchdog around a blocking region::
+
+        with health_runtime.watch("dispatch", program=key, cids=cids):
+            values = prog(*leaves)
+
+    Returns a shared no-op guard when the watchdog is disarmed (one
+    attribute read + one call on the hot path). ``deadline_ms`` overrides
+    the ambient deadline for this region only — and arms the guard even
+    when the ambient watchdog is disabled."""
+    if deadline_ms is None:
+        if not _WD_ACTIVE:
+            return _NULL_GUARD
+        deadline_s = _WD_DEADLINE_S
+    else:
+        deadline_s = max(0.001, float(deadline_ms) / 1e3)
+    return _Guard(site, deadline_s, program, cid, cids)
+
+
+def _ensure_thread() -> None:
+    global _WD_THREAD
+    if _WD_THREAD is None or not _WD_THREAD.is_alive():
+        _WD_THREAD = threading.Thread(
+            target=_wd_loop, name="heat-tpu-watchdog", daemon=True
+        )
+        _WD_THREAD.start()
+
+
+def _wd_loop() -> None:
+    while True:
+        due: List[_Guard] = []
+        now = time.perf_counter()
+        wait_s = 0.5
+        # snapshot: guards arm/disarm lock-free, so never iterate the live
+        # dict (list() of a dict is one GIL-atomic C call)
+        for g in list(_WD_GUARDS.values()):
+            if g.tripped or g.t0 == 0.0:
+                continue
+            remaining = g.t0 + g.deadline_s - now
+            if remaining <= 0:
+                g.tripped = True
+                due.append(g)
+            elif remaining < wait_s:
+                wait_s = remaining
+        if not due:
+            with _WD_COND:
+                _WD_COND.wait(timeout=max(0.005, wait_s))
+            continue
+        for g in due:  # diagnose OUTSIDE the lock: arms must never block
+            try:
+                _trip(g)
+            except Exception as exc:  # the monitor survives its own diagnosis
+                warnings.warn(f"watchdog diagnosis failed: {exc!r}", stacklevel=1)
+
+
+def _program_of_cid(cid) -> Optional[str]:
+    if cid is None:
+        return None
+    rec = _DISPATCHED.get(cid)
+    if rec is not None:
+        return rec[1]
+    for ev in reversed(_RING):
+        if ev.get("kind") == "dispatch" and (
+            ev.get("cid") == cid or cid in (ev.get("cids") or ())
+        ):
+            return ev.get("program")
+    return None
+
+
+def _pending_roots() -> List[dict]:
+    """Snapshot the still-pending DAG roots (cheap metadata walk over
+    fusion's live-root registry — never forces anything)."""
+    try:
+        from . import fusion
+
+        out = []
+        for key in sorted(fusion._LIVE_ROOTS.keys()):
+            wrapper = fusion._LIVE_ROOTS.get(key)
+            payload = getattr(wrapper, "_payload", None)
+            if isinstance(payload, fusion.LazyArray) and payload._value is None:
+                out.append({"cid": payload.cid, "depth": payload.depth})
+            if len(out) >= 32:
+                break
+        return out
+    except Exception as exc:  # half-imported runtime: diagnose what exists
+        return [{"error": repr(exc)}]
+
+
+def _collective_trail(limit: int = 16) -> Dict[str, Any]:
+    """This host's recent collective activity from the flight ring — the
+    local half of the cross-host parity comparison (a stalled collective
+    shows hosts whose ``counts`` diverge; ``trace_collective_parity`` makes
+    the same comparison over merged dumps)."""
+    recent: List[list] = []
+    counts: Dict[str, int] = {}
+    for ev in _RING:
+        kind = ev.get("kind")
+        if kind in ("collective", "fused_collective"):
+            op = str(ev.get("op"))
+            recent.append([kind, op, ev.get("cid")])
+            counts[op] = counts.get(op, 0) + int(ev.get("count", 1) or 1)
+    return {"recent": recent[-limit:], "counts": counts}
+
+
+def _stack_of(thread_ident: int) -> List[str]:
+    try:
+        frame = sys._current_frames().get(thread_ident)
+        if frame is None:
+            return []
+        return [ln.rstrip() for ln in traceback.format_stack(frame)][-12:]
+    except Exception:  # pragma: no cover - interpreter-dependent
+        return []
+
+
+def _trip(g: _Guard) -> None:
+    waited = time.perf_counter() - g.t0
+    diag = {
+        "ts": time.time(),
+        "site": g.site,
+        "waited_s": round(waited, 4),
+        "deadline_s": g.deadline_s,
+        "policy": _WD_POLICY,
+        "program": g.program or _program_of_cid(g.cid),
+        "cid": g.cid,
+        "cids": list(g.cids),
+        "pending_roots": _pending_roots(),
+        "collective_trail": _collective_trail(),
+        "stack": _stack_of(g.thread_ident),
+    }
+    _STALLS.append(diag)
+    _WD_STATS["trips"] += 1
+    telemetry.record_event(
+        "stall",
+        site=g.site,
+        program=diag["program"],
+        cid=g.cid,
+        waited_s=diag["waited_s"],
+    )
+    pending = ", ".join(str(r.get("cid")) for r in diag["pending_roots"][:6]) or "none"
+    warnings.warn(
+        StallWarning(
+            f"watchdog: {g.site} has been blocked {waited:.2f}s (deadline "
+            f"{g.deadline_s:.2f}s); in-flight program "
+            f"{diag['program'] or '<unknown>'}, pending root cids [{pending}]. "
+            "Full diagnosis via health_runtime.last_stall()"
+        ),
+        stacklevel=2,
+    )
+    if _WD_POLICY in ("dump", "raise"):
+        auto_dump("stall")
+
+
+def set_watchdog(deadline_ms=_UNSET, policy=_UNSET, enabled=_UNSET):
+    """Configure the watchdog in-process; returns the previous
+    ``(deadline_ms, policy, enabled)`` triple (pass it back to restore)."""
+    global _WD_DEADLINE_S, _WD_POLICY, _WD_ENABLED, _WD_ACTIVE
+    prev = (_WD_DEADLINE_S * 1e3, _WD_POLICY, _WD_ENABLED)
+    if deadline_ms is not _UNSET:
+        _WD_DEADLINE_S = max(0.0, float(deadline_ms)) / 1e3
+    if policy is not _UNSET:
+        if policy not in _WD_POLICIES:
+            raise ValueError(f"watchdog policy must be one of {_WD_POLICIES}")
+        _WD_POLICY = policy
+    if enabled is not _UNSET:
+        _WD_ENABLED = bool(enabled)
+    _WD_ACTIVE = _WD_ENABLED and _WD_DEADLINE_S > 0
+    return prev
+
+
+def watchdog_stats() -> Dict[str, Any]:
+    return {
+        "enabled": _WD_ENABLED,
+        "deadline_ms": round(_WD_DEADLINE_S * 1e3, 3),
+        "policy": _WD_POLICY,
+        "armed": len(_WD_GUARDS),
+        "arms": _WD_STATS["arms"],
+        "trips": _WD_STATS["trips"],
+    }
+
+
+def stalls() -> List[dict]:
+    """Every stall diagnosis this session (bounded, newest last)."""
+    return list(_STALLS)
+
+
+def last_stall() -> Optional[dict]:
+    """The most recent stall diagnosis, or None."""
+    return _STALLS[-1] if _STALLS else None
+
+
+# ----------------------------------------------------------------------
+# the report surface
+# ----------------------------------------------------------------------
+def health_block(global_view: bool = False) -> Dict[str, Any]:
+    """The ``report()["health"]`` block: flight-ring occupancy, watchdog
+    state + last stall, the three latency histogram tables ('*' = overall
+    row; ``dispatch``/``compile`` keyed by program key, ``sync`` by
+    trigger), and the rolling SLO gauges. Inside a ``telemetry.scope`` the
+    histograms are the scope's own isolated view unless ``global_view``."""
+    st = _H_GLOBAL if global_view else _H_STATES[-1]
+    return {
+        "flight": flight_stats(),
+        "watchdog": dict(watchdog_stats(), last_stall=last_stall()),
+        "sync": _render_hists(st, "sync"),
+        "dispatch": _render_hists(st, "dispatch"),
+        "compile": _render_hists(st, "compile"),
+        "slo": _slo_block(),
+    }
+
+
+def reset() -> None:
+    """Zero the session state — ring, drop/dump counters, histograms
+    (every active scope state + archives), SLO windows, stall log, watchdog
+    arm/trip counters. Configuration (flight enablement, ring cap, watchdog
+    deadline/policy, SLO limits, dump dir) survives — the same
+    config-vs-session split as ``memledger.reset``."""
+    global _RING_DROPPED, _DUMP_COUNT, _LAST_DUMP
+    _RING.clear()
+    _RING_DROPPED = 0
+    _DUMP_COUNT = 0
+    _LAST_DUMP = None
+    _LAST_AUTO_DUMP_TS.clear()
+    _DISPATCHED.clear()
+    for st in _H_STATES:
+        st.clear()
+    _H_SCOPES.clear()
+    for dq in _SLO_SAMPLES.values():
+        dq.clear()
+    for m in _SLO_BREACHES:
+        _SLO_BREACHES[m] = 0
+    _STALLS.clear()
+    for k in _WD_STATS:
+        _WD_STATS[k] = 0
+
+
+# joined-surface wiring (set-attribute, like memledger's _MEM_HOOK): the
+# telemetry module stays import-order independent of the health layer
+telemetry._SYNC_HOOK = _on_sync_end
+_install_hook()

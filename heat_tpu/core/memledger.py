@@ -711,6 +711,15 @@ def record_oom(
         ),
         stacklevel=5,
     )
+    try:
+        # black-box the OOM: the flight ring holds the dispatches that led
+        # here, and the bundle embeds this report (lazy import — this module
+        # must stay importable without the health layer)
+        from . import health_runtime
+
+        health_runtime.auto_dump("oom")
+    except Exception as dump_exc:  # pragma: no cover - import-order safety
+        warnings.warn(f"flight auto-dump after OOM failed: {dump_exc!r}", stacklevel=5)
     return report
 
 

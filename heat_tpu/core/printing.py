@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from . import telemetry
+from . import health_runtime, telemetry
 from .communication import get_comm
 
 _T_PRINT = telemetry.force_trigger("print")
@@ -78,7 +78,10 @@ def __str__(dndarray) -> str:
                 "print", cid=dndarray._payload.cid
             )
     with _T_PRINT:  # a repr that forces a pending chain reads as "print"
-        body = _format_data(dndarray, opts)
+        with health_runtime.watch(
+            "sync:print", cid=None if token is None else token.get("cid")
+        ):
+            body = _format_data(dndarray, opts)
     telemetry.end_blocking_sync(token)
     return (
         f"DNDarray({body}, dtype=heat_tpu.{dndarray.dtype.__name__}, "

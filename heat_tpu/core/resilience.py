@@ -123,6 +123,8 @@ __all__ = [
     "reset",
     "retry_policy",
     "suspended",
+    "StallError",
+    "StallWarning",
 ]
 
 
@@ -144,6 +146,21 @@ class NonFiniteError(FloatingPointError):
 class NonFiniteWarning(RuntimeWarning):
     """Non-finite values detected at a forcing point under
     ``ht.errstate(nonfinite="warn")``."""
+
+
+class StallWarning(UserWarning):
+    """The health-runtime watchdog found a blocking sync or fused dispatch
+    still in flight past its deadline; the structured diagnosis (in-flight
+    program key, pending DAG root cids, collective trail) is retrievable via
+    ``health_runtime.last_stall()``."""
+
+
+class StallError(TimeoutError):
+    """Raised at the guarded call site once it finally returns, when the
+    watchdog tripped during the wait and the policy is ``raise``. Like
+    NonFiniteError and MemoryBudgetExceeded this is a policy signal raised by
+    the health layer, not an XLA failure — it must propagate, never degrade
+    the chain to eager (see :func:`force_recoverable`)."""
 
 
 # ----------------------------------------------------------------------
@@ -396,9 +413,10 @@ def force_recoverable(exc: BaseException) -> bool:
     including ``MemoryError`` (OOM compiles are exactly the TPU failure mode
     worth surviving) — degrades; only our own policy signals propagate,
     since they are raised *by* the forcing point, not by XLA: the errstate
-    non-finite error and the memory admission gate's refusal (which fires
-    before the dispatch precisely so the pending chain stays intact)."""
-    if isinstance(exc, NonFiniteError):
+    non-finite error, the memory admission gate's refusal (which fires
+    before the dispatch precisely so the pending chain stays intact), and
+    the watchdog's stall escalation under the ``raise`` policy."""
+    if isinstance(exc, (NonFiniteError, StallError)):
         return False
     from .memledger import MemoryBudgetExceeded
 

@@ -225,6 +225,20 @@ _TOP_PROGRAMS = int(os.environ.get("HEAT_TPU_TELEMETRY_TOP_PROGRAMS", "5"))
 #: change memory; None until the ledger module loads.
 _MEM_HOOK = None
 
+#: flight-recorder hook (``core/health_runtime.py`` installs its ring-buffer
+#: append here at import — same set-attribute pattern as ``_MEM_HOOK``).
+#: Called with every typed event dict from :func:`_note_event`, including at
+#: plain ``HEAT_TPU_TELEMETRY=1`` where the verbose timelines stay empty —
+#: the always-on black box costs one deque append per event. None until the
+#: health module loads or when ``HEAT_TPU_FLIGHT=0``.
+_FLIGHT_HOOK = None
+
+#: blocking-sync completion hook (``core/health_runtime.py``): called as
+#: ``_SYNC_HOOK(kind, cid, dur_s)`` whenever :func:`end_blocking_sync`
+#: closes a token — feeds the host-wait latency histograms and resolves
+#: dispatch→done durations without this module importing the health layer.
+_SYNC_HOOK = None
+
 
 def active() -> bool:
     """Whether telemetry is recording (``HEAT_TPU_TELEMETRY`` knob)."""
@@ -269,7 +283,7 @@ class _State:
         "path", "t0", "wall_s", "calls", "collectives", "forces", "retraces",
         "compiles", "dispatches", "degraded", "unfused", "nonfinite",
         "io_retries", "checkpoint", "fused_collectives", "async_", "blocking",
-        "faults", "spans", "events", "events_dropped",
+        "sync_wait", "faults", "spans", "events", "events_dropped",
     )
 
     def __init__(self, path: str = ""):
@@ -293,6 +307,9 @@ class _State:
         self.fused_collectives: Dict[str, int] = {}
         self.async_ = {"dispatches": 0, "roots": 0, "multi_root_batches": 0}
         self.blocking: Dict[str, int] = {}
+        # true host-wait durations per trigger, aggregated in NON-verbose
+        # mode too (the verbose timeline carries the per-event stamps)
+        self.sync_wait: Dict[str, Dict[str, float]] = {}
         self.faults: Dict[str, int] = {}
         self.spans: Dict[str, Dict[str, Any]] = {}
         self.events: deque = deque(maxlen=_EVENT_CAP)
@@ -352,6 +369,11 @@ def _merge_state(dst: _State, src: _State) -> None:
     _add_int(dst.fused_collectives, src.fused_collectives)
     _add_int(dst.async_, src.async_)
     _add_int(dst.blocking, src.blocking)
+    for kind, rec in src.sync_wait.items():
+        d = dst.sync_wait.setdefault(kind, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+        d["count"] += rec["count"]
+        d["total_s"] += rec["total_s"]
+        d["max_s"] = max(d["max_s"], rec["max_s"])
     _add_int(dst.faults, src.faults)
     for path, rec in src.spans.items():
         d = dst.spans.setdefault(
@@ -389,12 +411,14 @@ def _cur() -> _State:
 
 def reset() -> None:
     """Clear every counter, span, event and completed scope of every active
-    state, and reset the ``utils/profiling`` timer registry and the
+    state, and reset the ``utils/profiling`` timer registry, the
     ``core/memledger`` session state (watermark, gate counters, stored OOM
-    report — the budget arming itself is configuration and survives) with
-    them: the report surfaces are joined — ``report()`` merges timers and
-    the memory block in, so a reset that left either stale would mislabel
-    the next bench's report. The mode is left untouched; active
+    report — the budget arming itself is configuration and survives) and the
+    ``core/health_runtime`` session state (flight ring, latency histograms,
+    SLO windows, stall log — knobs and watchdog arming survive) with them:
+    the report surfaces are joined — ``report()`` merges timers, the memory
+    block and the health block in, so a reset that left any stale would
+    mislabel the next bench's report. The mode is left untouched; active
     :func:`scope`/:func:`span` stacks keep recording."""
     for st in _STATES:
         st.clear()
@@ -409,6 +433,12 @@ def reset() -> None:
         from . import memledger
 
         memledger.reset()
+    except Exception:  # pragma: no cover - import-order safety only
+        pass
+    try:
+        from . import health_runtime
+
+        health_runtime.reset()
     except Exception:  # pragma: no cover - import-order safety only
         pass
 
@@ -430,14 +460,37 @@ def _emit(kind: str, **fields) -> dict:
     return ev
 
 
+def _note_event(kind: str, **fields) -> Optional[dict]:
+    """Record one typed event on the joined timeline surfaces: the verbose
+    per-state timelines (``_MODE >= 2``) and — even at plain
+    ``HEAT_TPU_TELEMETRY=1`` — the always-on flight ring when
+    ``core/health_runtime.py`` has installed ``_FLIGHT_HOOK``. Returns the
+    (shared, mutable) event dict when anything recorded it, else None."""
+    if _MODE >= 2:
+        ev = _emit(kind, **fields)
+        if _FLIGHT_HOOK is not None:
+            _FLIGHT_HOOK(ev)
+        return ev
+    if _MODE and _FLIGHT_HOOK is not None:
+        ev = {"kind": kind, "ts": time.perf_counter()}
+        ev.update(fields)
+        if _SCOPE_STACK:
+            ev["scope"] = _SCOPE_STACK[-1].path
+        _FLIGHT_HOOK(ev)
+        return ev
+    return None
+
+
 def record_event(kind: str, **fields) -> Optional[dict]:
     """Emit one typed trace-timeline event (no counter side effects). The
     public seam for subsystems with lifecycle phases worth a timestamp but
-    no counter (checkpoint phases, io ingest milestones). No-op unless
-    ``HEAT_TPU_TELEMETRY=verbose``; returns the (mutable) event dict."""
-    if _MODE < 2:
+    no counter (checkpoint phases, io ingest milestones). Lands on the
+    verbose timeline (``HEAT_TPU_TELEMETRY=verbose``) and on the flight ring
+    (any active mode, flight armed); returns the (mutable) event dict, or
+    None when nothing recorded it."""
+    if not _MODE:
         return None
-    return _emit(kind, **fields)
+    return _note_event(kind, **fields)
 
 
 def events() -> List[dict]:
@@ -468,6 +521,12 @@ def scope(name: str):
     st = _State(path)
     _SCOPE_STACK.append(st)
     _STATES.append(st)
+    try:  # the health layer scopes its histograms alongside (joined surface)
+        from . import health_runtime
+
+        health_runtime._push_scope(path)
+    except Exception:  # pragma: no cover - import-order safety only
+        pass
     try:
         yield path
     finally:
@@ -484,6 +543,12 @@ def scope(name: str):
             acc.calls = 0
             acc.wall_s = 0.0
         _merge_state(acc, st)
+        try:
+            from . import health_runtime
+
+            health_runtime._pop_scope(path)
+        except Exception:  # pragma: no cover - import-order safety only
+            pass
 
 
 def _scope_doc(st: _State) -> Dict[str, Any]:
@@ -606,8 +671,8 @@ def record_collective(
             rec["axes"][axis] = rec["axes"].get(axis, 0) + count
         if dtype is not None:
             rec["dtypes"][dtype] = rec["dtypes"].get(dtype, 0) + count
-    if _MODE >= 2:
-        _emit(
+    if _MODE >= 2 or _FLIGHT_HOOK is not None:
+        _note_event(
             "collective",
             op=op, axis=axis, bytes=int(nbytes), dtype=dtype, count=count,
             traced=_in_trace(),
@@ -655,8 +720,7 @@ def record_fused_collective(kind: str, cid: Optional[int] = None) -> None:
         return
     for st in _STATES:
         st.fused_collectives[kind] = st.fused_collectives.get(kind, 0) + 1
-    if _MODE >= 2:
-        _emit("fused_collective", op=kind, cid=cid)
+    _note_event("fused_collective", op=kind, cid=cid)
 
 
 def fused_collectives() -> Dict[str, int]:
@@ -686,8 +750,7 @@ def record_async_dispatch(
         st.async_["roots"] += int(n_roots)
         if n_roots > 1:
             st.async_["multi_root_batches"] += 1
-    if _MODE >= 2:
-        _emit("dispatch", roots=int(n_roots), cid=cid, cids=list(cids), program=program)
+    _note_event("dispatch", roots=int(n_roots), cid=cid, cids=list(cids), program=program)
     if _MEM_HOOK is not None:
         _MEM_HOOK("dispatch")
 
@@ -699,24 +762,45 @@ def record_blocking_sync(kind: str, cid: Optional[int] = None) -> Optional[dict]
     assertable surface for "this chain cost one sync".
 
     ``cid`` is the pending chain's correlation id. Returns the timeline
-    event (verbose mode) so the call site can close it with
+    event token (any active mode) so the call site can close it with
     :func:`end_blocking_sync` once the host actually holds the value — the
-    event then carries the true wall duration of the sync."""
+    event then carries the true wall duration of the sync. In verbose mode
+    the token lives on the per-state timelines; at plain mode it feeds the
+    flight ring and the ``sync_wait`` aggregate (the non-verbose answer to
+    "how long did we wait")."""
     if not _MODE:
         return None
     for st in _STATES:
         st.blocking[kind] = st.blocking.get(kind, 0) + 1
-    if _MODE >= 2:
-        return _emit("blocking_sync", where=kind, cid=cid)
-    return None
+    ev = _note_event("blocking_sync", where=kind, cid=cid)
+    if ev is not None:
+        return ev
+    # mode 1, flight disarmed: a plain token still feeds the wait aggregate
+    return {"kind": "blocking_sync", "ts": time.perf_counter(), "where": kind, "cid": cid}
 
 
 def end_blocking_sync(token: Optional[dict]) -> None:
     """Close a blocking-sync timeline event returned by
     :func:`record_blocking_sync`: stamps the wall ``dur`` the host boundary
-    spent from noting the pending chain to holding the materialized value."""
-    if token is not None:
-        token["dur"] = time.perf_counter() - token["ts"]
+    spent from noting the pending chain to holding the materialized value,
+    folds it into every active state's ``sync_wait`` aggregate (count /
+    total / max per trigger — reported in non-verbose mode too), and feeds
+    the health layer's latency histograms via ``_SYNC_HOOK``."""
+    if token is None:
+        return
+    dur = time.perf_counter() - token["ts"]
+    token["dur"] = dur
+    kind = str(token.get("where"))
+    for st in _STATES:
+        rec = st.sync_wait.get(kind)
+        if rec is None:
+            rec = st.sync_wait[kind] = {"count": 0, "total_s": 0.0, "max_s": 0.0}
+        rec["count"] += 1
+        rec["total_s"] += dur
+        if dur > rec["max_s"]:
+            rec["max_s"] = dur
+    if _SYNC_HOOK is not None:
+        _SYNC_HOOK(kind, token.get("cid"), dur)
 
 
 def _render_async(st: _State) -> Dict[str, Any]:
@@ -726,6 +810,14 @@ def _render_async(st: _State) -> Dict[str, Any]:
         "multi_root_batches": st.async_["multi_root_batches"],
         "blocking_syncs": dict(st.blocking),
         "blocking_total": sum(st.blocking.values()),
+        "sync_wait": {
+            kind: {
+                "count": rec["count"],
+                "total_s": round(rec["total_s"], 6),
+                "max_s": round(rec["max_s"], 6),
+            }
+            for kind, rec in st.sync_wait.items()
+        },
     }
 
 
@@ -792,8 +884,7 @@ def record_force(trigger: str, depth: int, compiled: bool = False, cid: Optional
             rec["max_depth"] = int(depth)
         if compiled:
             rec["compiles"] += 1
-    if _MODE >= 2:
-        _emit("force", trigger=trigger, depth=int(depth), compiled=compiled, cid=cid)
+    _note_event("force", trigger=trigger, depth=int(depth), compiled=compiled, cid=cid)
     if _SPAN_STACK:
         for frame in _SPAN_STACK:
             frame.forces += 1
@@ -891,8 +982,7 @@ def record_compile(label: str, cid: Optional[int] = None) -> None:
         return
     for st in _STATES:
         st.compiles[label] = st.compiles.get(label, 0) + 1
-    if _MODE >= 2:
-        _emit("compile", label=label, cid=cid)
+    _note_event("compile", label=label, cid=cid)
 
 
 # ----------------------------------------------------------------------
@@ -954,8 +1044,7 @@ def record_degraded(family: tuple, stage: str, error: str = "") -> None:
         rec["stages"][stage] = rec["stages"].get(stage, 0) + 1
         if error:
             rec["last_error"] = error
-    if _MODE >= 2:
-        _emit("degraded", family=key, stage=stage, error=error)
+    _note_event("degraded", family=key, stage=stage, error=error)
 
 
 def degraded_counts() -> Dict[str, int]:
@@ -988,8 +1077,7 @@ def record_fault(site: str, pattern: str = "") -> None:
         return
     for st in _STATES:
         st.faults[site] = st.faults.get(site, 0) + 1
-    if _MODE >= 2:
-        _emit("fault", site=site, pattern=pattern)
+    _note_event("fault", site=site, pattern=pattern)
 
 
 def fault_events() -> Dict[str, int]:
@@ -1004,8 +1092,7 @@ def record_nonfinite(where: str) -> None:
         return
     for st in _STATES:
         st.nonfinite[where] = st.nonfinite.get(where, 0) + 1
-    if _MODE >= 2:
-        _emit("nonfinite", where=where)
+    _note_event("nonfinite", where=where)
 
 
 def nonfinite_counts() -> Dict[str, int]:
@@ -1019,8 +1106,7 @@ def record_io_retry(site: str) -> None:
         return
     for st in _STATES:
         st.io_retries[site] = st.io_retries.get(site, 0) + 1
-    if _MODE >= 2:
-        _emit("io_retry", site=site)
+    _note_event("io_retry", site=site)
 
 
 def io_retries() -> Dict[str, int]:
@@ -1041,8 +1127,7 @@ def record_checkpoint(event: str, step: Optional[int] = None, detail: str = "") 
         return
     for st in _STATES:
         st.checkpoint[event] = st.checkpoint.get(event, 0) + 1
-    if _MODE >= 2:
-        _emit("checkpoint", event=event, step=step, detail=detail)
+    _note_event("checkpoint", event=event, step=step, detail=detail)
     if _MEM_HOOK is not None:
         _MEM_HOOK("checkpoint")
 
@@ -1197,6 +1282,21 @@ def _memory_block() -> Dict[str, Any]:
     return out
 
 
+def _health_block(global_view: bool = False) -> Dict[str, Any]:
+    """The runtime-health picture (``core/health_runtime.py``): flight-ring
+    occupancy, watchdog state + last stall diagnosis, per-program and
+    per-trigger latency histograms (p50/p90/p99) and the rolling SLO gauges.
+    Pure module state — never forces a chain, never initializes a backend.
+    ``global_view`` mirrors report()'s ``_state`` override: the background
+    metrics sink streams the GLOBAL histograms whatever scope is active."""
+    try:
+        from . import health_runtime
+
+        return health_runtime.health_block(global_view=global_view)
+    except Exception:  # pragma: no cover - import-order safety only
+        return {}
+
+
 def _programs_block(top: Optional[int] = None) -> Dict[str, Any]:
     """Top-N cached sharded programs by dispatch count (cheap metadata only;
     memoized cost estimates — including each program's static memory peaks —
@@ -1265,6 +1365,7 @@ def report(*, _state: Optional[_State] = None) -> Dict[str, Any]:
         },
         "scopes": scope_reports(),
         "memory": _memory_block(),
+        "health": _health_block(global_view=_state is not None),
     }
     try:
         from . import fusion
@@ -1358,6 +1459,9 @@ _INSTANT_KINDS = {
     "nonfinite": ("errstate", lambda ev: "nonfinite:" + str(ev.get("where"))),
     "memory_gate": ("memory", lambda ev: "gate:" + str(ev.get("policy"))),
     "memory_oom": ("memory", lambda ev: "oom:" + str(ev.get("program"))),
+    "stall": ("health", lambda ev: "stall:" + str(ev.get("site"))),
+    "slo_breach": ("health", lambda ev: "slo:" + str(ev.get("metric"))),
+    "flight_dump": ("health", lambda ev: "flight_dump:" + str(ev.get("reason"))),
 }
 
 

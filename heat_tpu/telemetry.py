@@ -10,6 +10,8 @@ exported Chrome/Perfetto trace files without writing any analysis code:
     $ python -m heat_tpu.telemetry validate-trace trace.json
     $ python -m heat_tpu.telemetry memory                 # live process ledger
     $ python -m heat_tpu.telemetry memory report.json --json
+    $ python -m heat_tpu.telemetry health                 # flight/watchdog/SLO
+    $ python -m heat_tpu.telemetry health flight_dump.json
 
 The implementation (and all state) lives in :mod:`heat_tpu.core.telemetry`;
 this module is a thin proxy (``heat_tpu.telemetry.report`` etc. delegate
@@ -248,6 +250,98 @@ def _show_memory(doc: Dict[str, Any], out) -> None:
 
 
 # ----------------------------------------------------------------------
+# health: flight recorder + watchdog + latency/SLO picture
+# ----------------------------------------------------------------------
+def _health_doc(report_path: Optional[str]) -> Dict[str, Any]:
+    """The health picture to render: a saved report's (or flight-dump
+    bundle's) ``health`` block when a path is given, else THIS process's
+    live block — pure module state, no mesh bring-up (the never-initialize
+    contract: asking for health must not pin a backend)."""
+    if report_path is not None:
+        doc = _load(report_path)
+        blk = doc.get("health") or {}
+        if not blk and "watchdog" in doc:  # a bare bundle without the block
+            blk = {"watchdog": doc.get("watchdog") or {}}
+        return {"source": report_path, "health": blk, "stalls": doc.get("stalls") or []}
+    from heat_tpu.core import health_runtime
+
+    return {
+        "source": "<live>",
+        "health": health_runtime.health_block(global_view=True),
+        "stalls": health_runtime.stalls(),
+    }
+
+
+def _ms(v) -> str:
+    try:
+        return f"{float(v) * 1e3:.2f}ms"
+    except (TypeError, ValueError):
+        return "?"
+
+
+def _show_health(doc: Dict[str, Any], out) -> None:
+    blk = doc.get("health") or {}
+    print(f"health ({doc.get('source', '?')}):", file=out)
+    fl = blk.get("flight") or {}
+    if fl:
+        state = "armed" if fl.get("enabled") else "DISARMED"
+        dropped = f", {fl['dropped']} dropped" if fl.get("dropped") else ""
+        last = f"  last dump: {fl['last_dump']}" if fl.get("last_dump") else ""
+        print(
+            f"  flight: {state}, {fl.get('events', 0)}/{fl.get('cap', 0)} "
+            f"events{dropped}, {fl.get('dumps', 0)} dump(s){last}",
+            file=out,
+        )
+    wd = blk.get("watchdog") or {}
+    if wd:
+        state = "armed" if wd.get("enabled") else "DISARMED"
+        print(
+            f"  watchdog: {state}, deadline {wd.get('deadline_ms', 0)}ms "
+            f"policy={wd.get('policy')} arms={wd.get('arms', 0)} "
+            f"trips={wd.get('trips', 0)}",
+            file=out,
+        )
+    for st in (doc.get("stalls") or [])[-3:]:
+        print(
+            f"  STALL: {st.get('site')} waited {st.get('waited_s')}s "
+            f"(deadline {st.get('deadline_s')}s) program={st.get('program')} "
+            f"pending={[r.get('cid') for r in st.get('pending_roots') or []]}",
+            file=out,
+        )
+    for metric, title in (
+        ("sync", "blocking-sync host wait"),
+        ("dispatch", "dispatch→done"),
+        ("compile", "compile time"),
+    ):
+        table = blk.get(metric) or {}
+        rows = [(k, r) for k, r in table.items() if r.get("count")]
+        if not rows:
+            continue
+        print(f"  {title}:", file=out)
+        rows.sort(key=lambda kv: (kv[0] != "*", -kv[1].get("count", 0)))
+        for key, rec in rows[:12]:
+            print(
+                f"    {key:<20} x{rec.get('count', 0):<6} "
+                f"p50 {_ms(rec.get('p50_s'))}  p90 {_ms(rec.get('p90_s'))}  "
+                f"p99 {_ms(rec.get('p99_s'))}  max {_ms(rec.get('max_s'))}",
+                file=out,
+            )
+    slo = blk.get("slo") or {}
+    for metric in ("sync", "dispatch", "compile"):
+        rec = slo.get(metric) or {}
+        if rec.get("limit_ms") is None:
+            continue
+        ratio = rec.get("ok_ratio")
+        print(
+            f"  SLO {metric}: limit {rec['limit_ms']}ms, {rec.get('recent', 0)} in "
+            f"window, {rec.get('window_breaches', 0)} breach(es)"
+            + (f", ok_ratio {ratio}" if ratio is not None else "")
+            + f", {rec.get('breaches_total', 0)} total",
+            file=out,
+        )
+
+
+# ----------------------------------------------------------------------
 # diff
 # ----------------------------------------------------------------------
 def _flatten_numeric(doc, prefix="") -> Dict[str, float]:
@@ -314,6 +408,20 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
     )
     p_mem.add_argument("--json", action="store_true", help="emit JSON instead of text")
     p_mem.add_argument("--top", type=int, default=5, help="top-K buffers/programs shown")
+    p_health = sub.add_parser(
+        "health",
+        help="runtime health: flight recorder, watchdog/stalls, latency "
+        "p50/p90/p99 and SLO gauges (from a report_json artifact or a "
+        "flight-dump bundle, or live from this process)",
+    )
+    p_health.add_argument(
+        "report",
+        nargs="?",
+        default=None,
+        help="a report_json artifact or flight-dump bundle; omitted = THIS "
+        "process's live health block (pure module state, no mesh bring-up)",
+    )
+    p_health.add_argument("--json", action="store_true", help="emit JSON instead of text")
     p_val = sub.add_parser(
         "validate-trace", help="check a Chrome/Perfetto trace-event JSON file"
     )
@@ -343,6 +451,13 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
             print(json.dumps(_core._jsonable(doc), indent=2, sort_keys=True), file=out)
         else:
             _show_memory(doc, out)
+        return 0
+    if args.cmd == "health":
+        doc = _health_doc(args.report)
+        if args.json:
+            print(json.dumps(_core._jsonable(doc), indent=2, sort_keys=True), file=out)
+        else:
+            _show_health(doc, out)
         return 0
     if args.cmd == "validate-trace":
         problems = _core.validate_trace(args.trace, cross_host=args.cross_host)

@@ -78,6 +78,21 @@ HEAT_TPU_FAULTS=ci HEAT_TPU_TELEMETRY=1 \
     tests/test_checkpoint_resilience.py tests/test_checkpoint_profiling.py \
     tests/test_fused_collectives.py tests/test_trace_timeline.py \
     tests/test_memory_obs.py -q -x
+# runtime-health leg (core/health_runtime.py): flight recorder ARMED with a
+# small ring and the stall watchdog live under the warn policy (every fused
+# dispatch and blocking sync pays the guard arm/disarm and the ring append)
+# while the health suite and the eager-chain suite run — the recorder,
+# watchdog and latency histograms must change no results, and the suite's
+# own trip/dump/percentile pins stay exact
+echo "=== runtime health (HEAT_TPU_FLIGHT=1, watchdog armed) ==="
+HEAT_TPU_FLIGHT=1 HEAT_TPU_FLIGHT_EVENTS=512 HEAT_TPU_WATCHDOG_POLICY=warn \
+HEAT_TPU_TELEMETRY=1 \
+  python -m pytest tests/test_health_runtime.py tests/test_eager_chain.py -q -x
+# bench regression-sentinel smoke: the file-vs-file compare path (no jax,
+# no measurement) must accept a banked round artifact against itself —
+# exercises record loading, envelope unwrap and threshold plumbing
+echo "=== bench sentinel smoke (--against/--record) ==="
+python bench.py --against BENCH_r05.json --record BENCH_r05.json
 # static-analysis leg (heat_tpu/analysis): the AST lint must be clean
 # against the committed baseline (zero NEW findings — suppressions carry
 # their justifications inline), the AOT program auditor over a cache

@@ -833,6 +833,65 @@ def worker() -> None:
     except Exception:  # noqa: BLE001 - diagnostics must never cost the record
         pass
 
+    # live-elasticity leg (core/elastic.py, ISSUE 12): a small DASO training
+    # run with one injected elastic.preempt — the full detect -> drain ->
+    # commit -> reform -> resume cycle, shedding half the mesh mid-run. Banks
+    # the per-reform downtime (preempt_recovery_ms: preemption observed to
+    # training resumed on the shrunk world, recompiles included — that IS the
+    # recovery bill) and the replay bill (steps_replayed_per_preempt, bounded
+    # by checkpoint_every). Runs AFTER the record is banked (hang-safety
+    # invariant), and restores the full bench mesh afterwards.
+    try:
+        import math as _math
+        import shutil as _shutil
+        import tempfile as _tempfile
+
+        from heat_tpu.core import communication as _communication
+        from heat_tpu.core import elastic as _elastic
+        from heat_tpu.core import resilience as _resilience
+
+        if comm.size > 1:
+            _lose = comm.size // 2
+            # batch rows must tile BOTH worlds (full and survivors)
+            _ebs = _math.lcm(comm.size, comm.size - _lose) * 2
+            _erng = np.random.default_rng(11)
+            _ebatches = [
+                (
+                    _erng.standard_normal((_ebs, 6)).astype(np.float32),
+                    _erng.integers(0, 4, _ebs).astype(np.int32),
+                )
+                for _ in range(8)
+            ]
+            _daso = ht.optim.DASO(
+                local_optimizer=ht.optim.SGD(0.05),
+                total_epochs=4, warmup_epochs=0, cooldown_epochs=0,
+            )
+            _daso.add_model(ht.nn.MLP(features=(8, 4)), 0, _ebatches[0][0][:2])
+            _edir = _tempfile.mkdtemp(prefix="heat_tpu_bench_elastic_")
+            try:
+                _elastic.reset()
+                with _resilience.inject("elastic.preempt", every=5, times=1):
+                    _eres = _elastic.fit(
+                        _daso, _ebatches, directory=_edir,
+                        checkpoint_every=3, max_reforms=1, lose=_lose,
+                        install_signals=False,
+                    )
+                _est = _eres["elastic"]
+                if _est["reforms"]:
+                    record["preempt_recovery_ms"] = round(
+                        _est["downtime_ms"] / _est["reforms"], 1
+                    )
+                    record["steps_replayed_per_preempt"] = round(
+                        _est["steps_replayed"] / _est["reforms"], 2
+                    )
+                    print(json.dumps(record), flush=True)  # last parseable line wins
+            finally:
+                _shutil.rmtree(_edir, ignore_errors=True)
+                _elastic.reset()
+                _communication.reform()  # the full world back for later legs
+    except Exception:  # noqa: BLE001 - diagnostics must never cost the record
+        pass
+
     # lloyd two-point marginal FIRST among the diagnostics, with the updated
     # record re-banked IMMEDIATELY after: a 10x-iteration program's time
     # spread cancels the per-program fixed cost (tunnel RTT ~67 ms measured
@@ -1243,6 +1302,15 @@ _OVERHEAD_CEILINGS = {
 #: static-analysis counters that must never grow between rounds
 _MONOTONE_KEYS = ("lint_findings", "audit_findings", "verify_findings")
 
+#: elastic-recovery costs with absolute ceilings (lower is better; the
+#: recovery bill of one preempt -> drain -> reform -> resume cycle); fresh
+#: regresses when it exceeds BOTH the ceiling and banked*1.5+2.0 — same
+#: noise logic as the overhead gauges, in ms / steps instead of percent
+_ELASTIC_CEILINGS = {
+    "preempt_recovery_ms": 60000.0,
+    "steps_replayed_per_preempt": 5.0,
+}
+
 
 def _load_record(path: str) -> dict:
     """A bench record from disk — unwraps the round-artifact envelope
@@ -1307,6 +1375,18 @@ def compare_records(fresh: dict, banked: dict, slack: float = 0.30) -> dict:
             regressions.append(
                 f"{key}: fresh {f:g}% > limit {limit:g}% "
                 f"(ceiling {ceiling:g}%, banked {b if b is not None else 'n/a'})"
+            )
+    for key, ceiling in _ELASTIC_CEILINGS.items():
+        f, b = _num(fresh, key), _num(banked, key)
+        if f is None:
+            if b is not None:
+                notes.append(f"{key}: banked={b:g} but missing from fresh record")
+            continue
+        limit = ceiling if b is None else max(ceiling, b * 1.5 + 2.0)
+        if f > limit:
+            regressions.append(
+                f"{key}: fresh {f:g} > limit {limit:g} "
+                f"(ceiling {ceiling:g}, banked {b if b is not None else 'n/a'})"
             )
     for key in _MONOTONE_KEYS:
         f, b = _num(fresh, key), _num(banked, key)

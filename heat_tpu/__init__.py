@@ -17,6 +17,7 @@ from .core import (
     complex_math,
     constants,
     devices,
+    elastic,
     exponential,
     factories,
     health_runtime,

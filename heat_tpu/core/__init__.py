@@ -22,6 +22,7 @@ from .resilience import errstate
 from . import memledger
 from . import health_runtime
 from . import fusion
+from . import elastic
 from .dndarray import *
 from .factories import *
 from .memory import *

@@ -49,6 +49,7 @@ __all__ = [
     "MeshCommunication",
     "get_comm",
     "initialize",
+    "reform",
     "sanitize_comm",
     "use_comm",
 ]
@@ -526,6 +527,50 @@ def _distributed_client_live() -> bool:
         return False  # private-module layout changed: read as "not connected"
 
 
+def _refresh_world_state() -> None:
+    """Invalidate every mesh-keyed cache after the world changed.
+
+    A refreshed/re-formed world makes three kinds of stale state dangerous:
+    compiled shard_map programs hold shardings naming the *old* devices
+    (dispatching one against a lost device is a runtime crash, not a cache
+    miss), fusion's program cache and ``_PROGRAM_INFO`` are keyed the same
+    way, and memledger's resolved budget is a fraction of the old world's
+    per-device capacity. Per-instance metadata memos (sharding/counts/lshape
+    caches) die with their ``MeshCommunication`` instance and need no help.
+    Each teardown is individually best-effort: a subsystem that was never
+    imported has nothing to clear."""
+    _apply_program.cache_clear()
+    try:
+        from . import fusion
+
+        fusion.clear_cache()
+    except Exception:  # pragma: no cover - fusion unavailable/uninitialized
+        pass
+    try:
+        from . import memledger
+
+        memledger.invalidate_resolved_budget()
+    except Exception:  # pragma: no cover - memledger unavailable
+        pass
+
+
+def reform(devices: Optional[Sequence] = None) -> MeshCommunication:
+    """Re-form the default world on ``devices`` (all live devices if None).
+
+    The elastic supervisor's world-rebuild step (core/elastic.py): installs a
+    fresh ``MeshCommunication`` over the surviving device set as
+    ``MESH_WORLD``/default comm and invalidates every mesh-keyed cache via
+    :func:`_refresh_world_state`. Also the test-suite idiom for restoring the
+    full world after an elasticity test: ``reform()`` with no arguments."""
+    global MESH_WORLD, MESH_SELF, __default_comm
+    comm = MeshCommunication(devices)
+    MESH_WORLD = comm
+    MESH_SELF = MeshCommunication(comm.devices[:1])
+    __default_comm = comm
+    _refresh_world_state()
+    return comm
+
+
 def initialize(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -546,15 +591,13 @@ def initialize(
     are swallowed. Returns the refreshed default comm (and installs it via
     :func:`use_comm`).
     """
-    global MESH_WORLD, MESH_SELF, __default_comm
     if _distributed_client_live():
         # state probe, not message parsing: the runtime is already connected,
         # so re-initialization is a no-op regardless of how a second
         # ``jax.distributed.initialize`` would word its complaint
-        MESH_WORLD = MeshCommunication()
-        MESH_SELF = MeshCommunication(jax.devices()[:1])
-        __default_comm = MESH_WORLD
-        return MESH_WORLD
+        # a re-entry after device loss must not leave compiled programs /
+        # fusion caches holding shardings keyed on the pre-refresh devices
+        return reform()
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -590,10 +633,7 @@ def initialize(
             )
         else:
             raise
-    MESH_WORLD = MeshCommunication()
-    MESH_SELF = MeshCommunication(jax.devices()[:1])
-    __default_comm = MESH_WORLD
-    return MESH_WORLD
+    return reform()
 
 
 def _world() -> MeshCommunication:

@@ -794,9 +794,11 @@ def force(node):
             telemetry.record_force(
                 telemetry.current_trigger(), node.depth, compiled=missed, cid=node.cid
             )
-        if memledger._BUDGET_RAW is not None:
+        if memledger._BUDGET_RAW is not None or memledger._HOLD is not None:
             # headroom admission gate (core/memledger.py): live ledger bytes
             # + this program's static peak against HEAT_TPU_MEMORY_BUDGET.
+            # An elastic admission hold routes through the same seam even
+            # with no budget armed — the supervisor's stop-the-world window.
             # Sits BEFORE the guarded try, so the `raise` policy surfaces to
             # the caller with the chain intact instead of degrading to an
             # eager replay that would dispatch the same bytes anyway.

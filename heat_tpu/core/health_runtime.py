@@ -84,6 +84,7 @@ __all__ = [
     "last_stall",
     "note_dispatch",
     "reset",
+    "reset_guards",
     "set_dump_dir",
     "set_flight",
     "set_slo",
@@ -263,7 +264,10 @@ def dump_flight(path: Optional[str] = None, reason: str = "manual") -> Dict[str,
 
 def auto_dump(reason: str) -> Optional[Dict[str, Any]]:
     """The crash-dump trigger wired at the failure seams (memledger OOM,
-    fusion degrade, watchdog trip). Throttled per reason
+    fusion degrade, watchdog trip, and the elastic supervisor's
+    ``elastic_preempt`` / ``elastic_reformed`` / ``elastic_reform_failed``
+    milestones — a reform that fails still leaves a forensics bundle).
+    Throttled per reason
     (``HEAT_TPU_FLIGHT_DUMP_EVERY_S``) so a degrade storm writes one bundle,
     not thousands; a no-op unless the recorder is enabled and telemetry is
     active (an empty ring has nothing to explain)."""
@@ -664,6 +668,20 @@ def watch(site: str, program=None, cid=None, cids=(), deadline_ms=None):
     else:
         deadline_s = max(0.001, float(deadline_ms) / 1e3)
     return _Guard(site, deadline_s, program, cid, cids)
+
+
+def reset_guards() -> int:
+    """Drop every armed watchdog guard, returning how many were dropped.
+
+    The elastic reform path (core/elastic.py): guards armed over regions
+    that the drain abandoned (a collective that will never complete on the
+    lost device) must not trip minutes later against the re-formed world.
+    The owning threads' ``_Guard.__exit__`` still runs and pops a missing
+    ident harmlessly; with ``tripped`` never set, no stale ``StallError``
+    surfaces."""
+    dropped = len(_WD_GUARDS)
+    _WD_GUARDS.clear()
+    return dropped
 
 
 def _ensure_thread() -> None:

@@ -74,9 +74,13 @@ __all__ = [
     "MemoryBudgetExceeded",
     "MemoryBudgetWarning",
     "MemoryExhaustedWarning",
+    "admission_hold",
     "admit",
     "budget_info",
+    "gate_exempt",
     "gate_stats",
+    "hold_info",
+    "invalidate_resolved_budget",
     "is_oom",
     "last_oom",
     "ledger",
@@ -457,12 +461,64 @@ _RESOLVED_BUDGET: Optional[int] = None
 _GATE_STATS = {
     "checks": 0, "allowed": 0, "exceeded": 0,
     "drains": 0, "drained_roots": 0, "warned": 0, "raised": 0,
+    "held": 0,
 }
 _WARNED_KEYS: set = set()
 
 #: reentrancy guard: a drain forces other pending roots, whose forces must
 #: not re-enter the gate (they are the freeing, not new admissions)
 _IN_GATE = False
+
+#: non-None = every NEW fused-dispatch admission is refused, naming the
+#: holder — the elastic supervisor's "stop admitting" seam during its
+#: drain → checkpoint → reform window (reuses this gate rather than adding
+#: a second dispatch interlock)
+_HOLD: Optional[str] = None
+
+
+@contextmanager
+def admission_hold(reason: str):
+    """Refuse every NEW fused-dispatch admission for the scope's duration:
+    :func:`admit` raises :class:`MemoryBudgetExceeded` naming ``reason``,
+    leaving the refused chain pending (it dispatches after release, exactly
+    like the budget ``raise`` policy). Reentrant/drain forces pass — under
+    :func:`gate_exempt` or ``_IN_GATE`` they are the draining itself, not
+    new work. The elastic supervisor holds admissions while it drains live
+    roots and re-forms the mesh so no dispatch races the dying world."""
+    global _HOLD
+    prev, _HOLD = _HOLD, str(reason)
+    try:
+        yield
+    finally:
+        _HOLD = prev
+
+
+@contextmanager
+def gate_exempt():
+    """Run with the admission gate held open (``_IN_GATE`` semantics): every
+    :func:`admit` inside returns immediately. The elastic supervisor wraps
+    its drain/commit/restore in this — those forces ARE the drain."""
+    global _IN_GATE
+    prev, _IN_GATE = _IN_GATE, True
+    try:
+        yield
+    finally:
+        _IN_GATE = prev
+
+
+def hold_info() -> Optional[str]:
+    """The active admission hold's reason, or None."""
+    return _HOLD
+
+
+def invalidate_resolved_budget() -> None:
+    """Drop the memoized absolute budget so the next gate check re-resolves
+    a fractional ``HEAT_TPU_MEMORY_BUDGET`` against the LIVE backend: an
+    elastic mesh reform changes the device set the fraction denominates
+    over, and a stale denominator would admit against dead devices'
+    memory."""
+    global _RESOLVED_BUDGET
+    _RESOLVED_BUDGET = None
 
 
 def set_budget(budget=None, policy: Optional[str] = None):
@@ -562,9 +618,20 @@ def admit(program: str, family: str, static_peak: int, source: str, drain_fn=Non
     ``memory_analysis`` peak when available — ``source="static"`` — else the
     operand+result estimate). Within budget: returns. Over budget: applies
     the armed policy (see module docstring). Reentrant drains are admitted
-    unconditionally — they free memory, they don't claim it."""
+    unconditionally — they free memory, they don't claim it. An active
+    :func:`admission_hold` refuses every new admission regardless of budget
+    state — the elastic supervisor's stop-the-world window."""
     global _IN_GATE
-    if _BUDGET_RAW is None or _IN_GATE:
+    if _IN_GATE:
+        return
+    if _HOLD is not None:
+        _GATE_STATS["held"] += 1
+        raise MemoryBudgetExceeded(
+            f"dispatch admission held ({_HOLD}) for program {program} "
+            f"({family}) — the chain is left pending and dispatches once the "
+            "hold lifts (elastic drain/reform in progress)"
+        )
+    if _BUDGET_RAW is None:
         return
     budget = _resolve_budget()
     if budget is None:

@@ -30,12 +30,17 @@ Contract (documented in doc/internals_distribution.md):
   runtime has, and a named barrier across them. The checkpoint subsystem
   (``utils/checkpoint.py``) syncs after every host has published its shard
   files and before the owner hashes them into the manifest, so the commit
-  point never references files still in flight.
+  point never references files still in flight. ``HEAT_TPU_BARRIER_TIMEOUT_MS``
+  (default off) bounds the wait: a peer dead mid-barrier surfaces as a
+  ``resilience.StallError`` naming the tag instead of deadlocking.
 """
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+import os
+import threading
+import warnings
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 
@@ -48,6 +53,29 @@ __all__ = [
     "representative_rank",
     "sync_processes",
 ]
+
+#: values that read as "knob off" (the shared env-knob convention)
+_OFF_VALUES = ("", "0", "false", "off", "no")
+
+
+def _barrier_timeout_ms() -> Optional[float]:
+    """The ``HEAT_TPU_BARRIER_TIMEOUT_MS`` knob: off by default (an infinite
+    barrier is the correct production default — a slow peer is not a dead
+    peer), a positive millisecond bound otherwise. Malformed values warn and
+    read as off, never take the process down."""
+    raw = os.environ.get("HEAT_TPU_BARRIER_TIMEOUT_MS", "").strip().lower()
+    if raw in _OFF_VALUES:
+        return None
+    try:
+        value = float(raw)
+    except ValueError:
+        warnings.warn(
+            f"HEAT_TPU_BARRIER_TIMEOUT_MS={raw!r} is not a number; barrier "
+            "timeout stays off",
+            stacklevel=2,
+        )
+        return None
+    return value if value > 0 else None
 
 
 def process_index() -> int:
@@ -69,19 +97,57 @@ def process_count() -> int:
         return 1
 
 
-def sync_processes(tag: str) -> None:
+def sync_processes(tag: str, timeout_ms: Optional[float] = None) -> None:
     """Named barrier across controller processes (no-op on a single host).
 
     Cooperative multi-file protocols (the sharded checkpoint writer) need
     one ordering guarantee the per-file atomic renames cannot give: every
     host's files are on the shared filesystem before the owner publishes the
     manifest that references them. ``tag`` names the barrier so mismatched
-    call sites fail loudly instead of deadlocking silently."""
+    call sites fail loudly instead of deadlocking silently.
+
+    A peer that died mid-barrier would hang the survivors forever —
+    ``jax``'s barrier has no timeout parameter. ``timeout_ms`` (or the
+    ambient ``HEAT_TPU_BARRIER_TIMEOUT_MS`` knob; off by default) bounds the
+    wait: the barrier runs on a daemon worker thread, and when the bound
+    expires a ``resilience.StallError`` naming the barrier tag surfaces at
+    the call site instead of a deadlock. The checkpoint subsystem's save and
+    commit barriers route through here, so an elastic supervisor can treat
+    "peer lost during checkpoint" as a preemption rather than a hang."""
     if process_count() <= 1:
         return
-    from jax.experimental import multihost_utils  # pragma: no cover - multi-host only
+    from jax.experimental import multihost_utils
 
-    multihost_utils.sync_global_devices(tag)  # pragma: no cover - multi-host only
+    if timeout_ms is None:
+        timeout_ms = _barrier_timeout_ms()
+    if timeout_ms is None:
+        multihost_utils.sync_global_devices(tag)  # pragma: no cover - multi-host only
+        return
+    failure: List[BaseException] = []
+    done = threading.Event()
+
+    def _barrier() -> None:
+        try:
+            multihost_utils.sync_global_devices(tag)
+        except BaseException as exc:  # noqa: BLE001 - relayed to the caller
+            failure.append(exc)
+        finally:
+            done.set()
+
+    worker = threading.Thread(
+        target=_barrier, name=f"heat-tpu-barrier:{tag}", daemon=True
+    )
+    worker.start()
+    if not done.wait(float(timeout_ms) / 1e3):
+        from . import resilience
+
+        raise resilience.StallError(
+            f"barrier {tag!r} still waiting after {timeout_ms:g}ms "
+            "(HEAT_TPU_BARRIER_TIMEOUT_MS): a peer process likely died "
+            "mid-barrier; the hung sync is abandoned on its daemon thread"
+        )
+    if failure:
+        raise failure[0]
 
 
 def io_owner(proc: int | None = None) -> bool:

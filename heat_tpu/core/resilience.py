@@ -43,6 +43,10 @@ site                  where it fires
 ``checkpoint.gc``     each retention / debris deletion of checkpoint GC
                       (failures degrade to a warning; debris waits for the
                       next sweep)
+``elastic.preempt``   the elastic supervisor's per-step preemption poll
+                      (``core/elastic.py``) — arming it kills-a-host
+                      deterministically: the supervisor converts the fault
+                      into a drain → checkpoint → mesh-reform cycle
 ====================  =====================================================
 
 :func:`inject` arms a site from a test or an experiment::
@@ -108,6 +112,7 @@ from . import telemetry
 __all__ = [
     "DegradedDispatchWarning",
     "FaultInjected",
+    "MeshDegradedWarning",
     "NonFiniteError",
     "NonFiniteWarning",
     "RetryPolicy",
@@ -115,12 +120,16 @@ __all__ = [
     "call_with_retries",
     "check",
     "check_nonfinite",
+    "degraded_devices",
+    "device_fault_counts",
     "errstate",
     "fault_counts",
     "force_recoverable",
     "inject",
+    "note_device_fault",
     "record_recoverable",
     "reset",
+    "reset_device_faults",
     "retry_policy",
     "suspended",
     "StallError",
@@ -161,6 +170,14 @@ class StallError(TimeoutError):
     NonFiniteError and MemoryBudgetExceeded this is a policy signal raised by
     the health layer, not an XLA failure — it must propagate, never degrade
     the chain to eager (see :func:`force_recoverable`)."""
+
+
+class MeshDegradedWarning(UserWarning):
+    """Repeated ``collective.*``/dispatch faults attributable to ONE device
+    crossed the per-device threshold (``HEAT_TPU_DEVICE_FAULT_THRESHOLD``):
+    the device is marked degraded in the ledger so the elastic supervisor
+    (``core/elastic.py``) shrinks the *mesh* around it at the next reform —
+    the fault pattern degrades the topology, not the job."""
 
 
 # ----------------------------------------------------------------------
@@ -370,6 +387,86 @@ def fault_counts() -> Dict[str, int]:
 def reset() -> None:
     """Zero the per-site fired counters (armed specs keep their own state)."""
     _FIRED.clear()
+
+
+# ----------------------------------------------------------------------
+# quarantine escalation: the per-device fault ledger
+# ----------------------------------------------------------------------
+# fusion's quarantine (fusion.py) contains a failure to ONE program; when
+# the failures cluster on one DEVICE the right containment is topological —
+# drop the device from the mesh, keep the job. The ledger below is the
+# accounting that decides when a fault pattern is "one flaky device":
+# callers (the elastic supervisor's health probes, collective failure
+# handlers) attribute each fault to a device; crossing the threshold marks
+# the device degraded, which core/elastic.py consumes as a mesh-shrink
+# trigger at its next preemption poll.
+
+def _parse_device_fault_threshold() -> int:
+    raw = os.environ.get("HEAT_TPU_DEVICE_FAULT_THRESHOLD", "").strip()
+    if not raw:
+        return 3
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        warnings.warn(
+            f"HEAT_TPU_DEVICE_FAULT_THRESHOLD={raw!r} is not an int; using 3",
+            stacklevel=1,
+        )
+        return 3
+
+
+#: faults-per-device before the device is marked degraded
+_DEVICE_FAULT_THRESHOLD = _parse_device_fault_threshold()
+
+#: per-device attributed-fault counts (assertable surface, str(device) keys)
+_DEVICE_FAULTS: Dict[str, int] = {}
+#: devices past the threshold — the elastic supervisor's shrink set
+_DEGRADED_DEVICES: set = set()
+
+
+def note_device_fault(device, site: str = "collective") -> bool:
+    """Attribute one ``collective.*``/dispatch fault to ``device`` in the
+    per-device ledger. Crossing ``HEAT_TPU_DEVICE_FAULT_THRESHOLD`` (default
+    3) marks the device degraded, emits a ``mesh_degraded`` telemetry event
+    and warns :class:`MeshDegradedWarning`; returns True exactly when this
+    call crossed the threshold. Faults *spread* across devices never trip it
+    — only a per-device cluster reads as "this device is flaky"."""
+    key = str(device)
+    count = _DEVICE_FAULTS.get(key, 0) + 1
+    _DEVICE_FAULTS[key] = count
+    if key in _DEGRADED_DEVICES or count < _DEVICE_FAULT_THRESHOLD:
+        return False
+    _DEGRADED_DEVICES.add(key)
+    if telemetry._MODE:
+        telemetry.record_event(
+            "mesh_degraded", device=key, faults=count, site=site
+        )
+    warnings.warn(
+        MeshDegradedWarning(
+            f"device {key} accumulated {count} attributed fault(s) at {site} "
+            f"(threshold {_DEVICE_FAULT_THRESHOLD}): marked degraded — an "
+            "elastic supervisor will re-form the mesh without it"
+        ),
+        stacklevel=2,
+    )
+    return True
+
+
+def device_fault_counts() -> Dict[str, int]:
+    """The per-device attributed-fault ledger (``fault_counts()``-style)."""
+    return dict(_DEVICE_FAULTS)
+
+
+def degraded_devices() -> set:
+    """``str(device)`` keys currently past the degradation threshold."""
+    return set(_DEGRADED_DEVICES)
+
+
+def reset_device_faults() -> None:
+    """Clear the per-device ledger and the degraded set (a reformed mesh
+    starts with a clean bill of health)."""
+    _DEVICE_FAULTS.clear()
+    _DEGRADED_DEVICES.clear()
 
 
 # ----------------------------------------------------------------------

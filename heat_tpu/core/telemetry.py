@@ -239,6 +239,12 @@ _FLIGHT_HOOK = None
 #: dispatch→done durations without this module importing the health layer.
 _SYNC_HOOK = None
 
+#: elastic-supervisor stats hook (``core/elastic.py`` installs its ``stats``
+#: snapshot here at import — same set-attribute pattern). ``report()`` calls
+#: it to populate ``report()["elastic"]`` (preemptions survived, reforms,
+#: downtime, steps replayed); None until the elastic module loads.
+_ELASTIC_HOOK = None
+
 
 def active() -> bool:
     """Whether telemetry is recording (``HEAT_TPU_TELEMETRY`` knob)."""
@@ -439,6 +445,12 @@ def reset() -> None:
         from . import health_runtime
 
         health_runtime.reset()
+    except Exception:  # pragma: no cover - import-order safety only
+        pass
+    try:
+        from . import elastic
+
+        elastic.reset()
     except Exception:  # pragma: no cover - import-order safety only
         pass
 
@@ -1380,6 +1392,11 @@ def report(*, _state: Optional[_State] = None) -> Dict[str, Any]:
         doc["timers"] = profiling.report()
     except Exception:  # pragma: no cover
         pass
+    if _ELASTIC_HOOK is not None:
+        try:
+            doc["elastic"] = _ELASTIC_HOOK()
+        except Exception:  # pragma: no cover - the report never fails
+            pass
     if _MODE >= 2:
         doc["events"] = list(st.events)
     return doc

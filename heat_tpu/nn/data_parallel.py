@@ -203,6 +203,33 @@ class DataParallel:
             self.opt_state = self.optimizer.init(sd)
         return self
 
+    def rebind(self, comm: Optional[MeshCommunication] = None) -> "DataParallel":
+        """Re-target the trainer onto a (possibly shrunk) world — the
+        elastic reform step. Replicated state is mesh-shape-independent, so
+        rebinding is re-placement onto the new mesh's replicated sharding
+        plus a rebuild of the jitted step (whose ``out_shardings`` name the
+        old mesh)."""
+        self.comm = sanitize_comm(comm)
+        if self.params is not None:
+            rep = self._replicated()
+            place = lambda t: jax.tree.map(
+                lambda a: jax.device_put(a, rep) if hasattr(a, "shape") else a, t
+            )
+            self.params = place(self.params)
+            if self.state is not None:
+                self.state = place(self.state)
+            self.opt_state = place(self.opt_state)
+        if self._train_step is not None:
+            self._build(None)
+        return self
+
+    def fit(self, batches, **kwargs):
+        """Preemption-tolerant training over ``batches`` — delegates to
+        :func:`heat_tpu.elastic.fit` (see core/elastic.py for the knobs)."""
+        from ..core import elastic
+
+        return elastic.fit(self, batches, **kwargs)
+
     def save(self, directory: str, step: int = 0, keep: int = 3) -> str:
         """Write a manifest-based checkpoint ``directory/ckpt_{step}.manifest.json``
         (+ per-leaf payload files; the manifest rename is the commit point —
@@ -272,6 +299,13 @@ class DataParallelMultiGPU(DataParallel):
         return super().forward(x)
 
     __call__ = forward
+
+    def rebind(self, comm=None):
+        if self.daso is not None:
+            self.daso.rebind(comm)
+            self.comm = self.daso.comm
+            return self
+        return super().rebind(comm)
 
     def save(self, directory: str, step: int = 0, keep: int = 3) -> str:
         if self.daso is not None:

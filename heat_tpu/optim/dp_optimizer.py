@@ -461,6 +461,79 @@ class DASO:
         self._place()  # re-establish the dcn shardings on this mesh
         return self
 
+    # ------------------------------------------------------------------
+    # elastic surface (core/elastic.py): mesh-shape-independent state and
+    # world rebinding, so a preempted job can restore onto a SHRUNK mesh
+    # ------------------------------------------------------------------
+    def elastic_state_dict(self):
+        """Mesh-shape-independent resumable state.
+
+        :meth:`state_dict` params carry a leading per-device replica axis —
+        restorable only onto the same device count. Here that axis is merged
+        out (float leaves averaged, int/bool leaves take replica 0 — an optax
+        step counter must not float-promote), which is exact whenever the
+        replicas agree (warmup/cooldown, or right after a global merge) and
+        the DASO stale-averaging approximation otherwise."""
+
+        def merge(a):
+            a = jnp.asarray(a)
+            if jnp.issubdtype(a.dtype, jnp.integer) or jnp.issubdtype(a.dtype, jnp.bool_):
+                return a[0]
+            return jnp.mean(a, axis=0)
+
+        sd = self.state_dict()
+        return {
+            "params": jax.tree.map(merge, sd["params"]),
+            "state": jax.tree.map(merge, sd["state"]),
+            "opt_state": jax.tree.map(merge, sd["opt_state"]),
+            "schedule": sd["schedule"],
+            "stability": sd["stability"],
+        }
+
+    def load_elastic_state_dict(self, sd) -> "DASO":
+        """Restore :meth:`elastic_state_dict` state onto the CURRENT mesh:
+        the merged replica broadcasts to this world's device count."""
+        n_dev = self.nodes * self.ici_size
+        bcast = lambda t: jax.tree.map(
+            lambda a: jnp.broadcast_to(jnp.asarray(a), (n_dev,) + jnp.shape(a)), t
+        )
+        self.params = bcast(sd["params"])
+        if self._stateful:
+            self.state = bcast(sd["state"])
+        self.opt_state = bcast(sd["opt_state"])
+        sched = sd["schedule"]
+        self.epoch = int(sched["epoch"])
+        self.current_batch = int(sched["current_batch"])
+        self.global_skip = int(sched["global_skip"])
+        self.local_skip = int(sched["local_skip"])
+        self.batches_to_wait = int(sched["batches_to_wait"])
+        self.stability.set_state(sd["stability"])
+        self._place()
+        return self
+
+    def rebind(self, comm: Optional[MeshCommunication] = None) -> "DASO":
+        """Re-target this trainer onto a (possibly shrunk) world.
+
+        The elastic reform step: carries the live state across via
+        :meth:`elastic_state_dict`, rebuilds the 2-axis mesh and the jitted
+        step/merge programs over the new device set (an old program would
+        dispatch against lost devices), and re-places the state. The DCN
+        group count shrinks to a divisor of the new device count when the
+        old one no longer divides it."""
+        sd = self.elastic_state_dict() if self.params is not None else None
+        self.comm = sanitize_comm(comm)
+        n_dev = self.comm.size
+        if self.nodes > n_dev or n_dev % self.nodes != 0:
+            self.nodes = 2 if n_dev % 2 == 0 and n_dev > 1 else 1
+        self.ici_size = n_dev // self.nodes
+        devices = np.asarray(self.comm.devices).reshape(self.nodes, self.ici_size)
+        self.mesh = Mesh(devices, ("dcn", "ici"))
+        if self.module is not None:
+            self._build()
+        if sd is not None:
+            self.load_elastic_state_dict(sd)
+        return self
+
     def save(self, directory: str, step: int = 0, keep: int = 3) -> str:
         """Write a manifest-based checkpoint ``directory/ckpt_{step}.manifest.json``
         (+ per-leaf payload files; the manifest rename is the commit point —

@@ -88,6 +88,16 @@ echo "=== runtime health (HEAT_TPU_FLIGHT=1, watchdog armed) ==="
 HEAT_TPU_FLIGHT=1 HEAT_TPU_FLIGHT_EVENTS=512 HEAT_TPU_WATCHDOG_POLICY=warn \
 HEAT_TPU_TELEMETRY=1 \
   python -m pytest tests/test_health_runtime.py tests/test_eager_chain.py -q -x
+# elasticity leg (core/elastic.py): the suite runs with the ambient
+# elastic.preempt fault site firing periodically — every 7th poll of
+# Supervisor.maybe_preempt() reports a preemption, so the drain → commit →
+# reform → resume cycle executes for real while the elastic suite and the
+# checkpoint-resilience suite run. Explicit inject()/suspended() scopes
+# suspend the ambient spec, so the suites' exact-count pins stay exact; the
+# kill-a-host DASO test (full-vs-shrunk trajectory match) runs here too.
+echo "=== elasticity (HEAT_TPU_FAULTS='elastic.preempt:every=7') ==="
+HEAT_TPU_FAULTS='elastic.preempt:every=7' HEAT_TPU_TELEMETRY=1 \
+  python -m pytest tests/test_elastic.py tests/test_checkpoint_resilience.py -q -x
 # bench regression-sentinel smoke: the file-vs-file compare path (no jax,
 # no measurement) must accept a banked round artifact against itself —
 # exercises record loading, envelope unwrap and threshold plumbing

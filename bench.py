@@ -556,6 +556,30 @@ def worker() -> None:
     except Exception:  # noqa: BLE001 - diagnostics must never cost the record
         pass
 
+    # tracelens leg (ISSUE 13): post-hoc diagnosis of a verbose reduction-
+    # chain window — attribution coverage (every wall-clock second of the
+    # window bucketed, unattributed remainder banked as a monotone-quality
+    # metric), the critical path's device-wait share, and the analyzer's own
+    # cost. Runs AFTER the record is banked (hang-safety invariant).
+    try:
+        if reduction_chain:
+            from heat_tpu.core import tracelens as _tracelens
+
+            with _telemetry.enabled("verbose"):
+                _telemetry.reset()
+                _reduction_chain_once()
+                _reduction_chain_once()
+                _tl_events = _telemetry.events()
+                _telemetry.reset()
+            _tl_t0 = time.perf_counter()
+            _tl_ana = _tracelens.analyze(_tl_events)
+            record["analyze_ms"] = round((time.perf_counter() - _tl_t0) * 1e3, 3)
+            record["unattributed_time_pct"] = _tl_ana["attribution"]["unattributed_pct"]
+            record["critical_path_sync_pct"] = _tl_ana["critical_path"]["sync_pct"]
+            print(json.dumps(record), flush=True)  # last parseable line wins
+    except Exception:  # noqa: BLE001 - diagnostics must never cost the record
+        pass
+
     # guarded-dispatch overhead (core/resilience.py): the chain rate with the
     # fault harness ARMED but never firing (an exhausted times=0 spec), so
     # every injection-site check on the force/io hot paths is actually paid —
@@ -1302,6 +1326,22 @@ _OVERHEAD_CEILINGS = {
 #: static-analysis counters that must never grow between rounds
 _MONOTONE_KEYS = ("lint_findings", "audit_findings", "verify_findings")
 
+#: tracelens costs/shares with absolute ceilings (analyzer wall time on the
+#: reduction-chain window; critical-path device-wait share) — same
+#: ``max(ceiling, banked*1.5+2.0)`` noise logic as the overhead gauges
+_TRACELENS_CEILINGS = {
+    "analyze_ms": 500.0,
+    "critical_path_sync_pct": 90.0,
+}
+
+#: monotone-QUALITY metrics: attribution coverage must stay near-total. The
+#: −30% rate slack deliberately does NOT apply — fresh regresses past BOTH
+#: the absolute ceiling and banked + 2 points (the small additive term is
+#: scheduler noise on sub-ms segments, not license to decay)
+_QUALITY_CEILINGS = {
+    "unattributed_time_pct": 5.0,
+}
+
 #: elastic-recovery costs with absolute ceilings (lower is better; the
 #: recovery bill of one preempt -> drain -> reform -> resume cycle); fresh
 #: regresses when it exceeds BOTH the ceiling and banked*1.5+2.0 — same
@@ -1387,6 +1427,31 @@ def compare_records(fresh: dict, banked: dict, slack: float = 0.30) -> dict:
             regressions.append(
                 f"{key}: fresh {f:g} > limit {limit:g} "
                 f"(ceiling {ceiling:g}, banked {b if b is not None else 'n/a'})"
+            )
+    for key, ceiling in _TRACELENS_CEILINGS.items():
+        f, b = _num(fresh, key), _num(banked, key)
+        if f is None:
+            if b is not None:
+                notes.append(f"{key}: banked={b:g} but missing from fresh record")
+            continue
+        limit = ceiling if b is None else max(ceiling, b * 1.5 + 2.0)
+        if f > limit:
+            regressions.append(
+                f"{key}: fresh {f:g} > limit {limit:g} "
+                f"(ceiling {ceiling:g}, banked {b if b is not None else 'n/a'})"
+            )
+    for key, ceiling in _QUALITY_CEILINGS.items():
+        f, b = _num(fresh, key), _num(banked, key)
+        if f is None:
+            if b is not None:
+                notes.append(f"{key}: banked={b:g} but missing from fresh record")
+            continue
+        limit = ceiling if b is None else max(ceiling, b + 2.0)
+        if f > limit:
+            regressions.append(
+                f"{key}: fresh {f:g} > limit {limit:g} (monotone-quality metric: "
+                f"ceiling {ceiling:g}, banked {b if b is not None else 'n/a'} "
+                "+ 2pt noise — the rate slack does not apply)"
             )
     for key in _MONOTONE_KEYS:
         f, b = _num(fresh, key), _num(banked, key)

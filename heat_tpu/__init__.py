@@ -37,6 +37,7 @@ from .core import (
     stride_tricks,
     telemetry,
     tiling,
+    tracelens,
     trigonometrics,
     types,
     version,

@@ -21,6 +21,7 @@ from . import resilience
 from .resilience import errstate
 from . import memledger
 from . import health_runtime
+from . import tracelens
 from . import fusion
 from . import elastic
 from .dndarray import *

@@ -1212,7 +1212,8 @@ def defer_reshard(payload: LazyArray, gshape, split, padded, axis, comm):
             raise
         return _unfused("reshard", "record_failed:" + type(exc).__name__)
     if telemetry._MODE:
-        telemetry.record_fused_collective("reshard", cid=node.cid)
+        detail = "replicated" if axis is None else f"split={int(axis)}"
+        telemetry.record_fused_collective("reshard", cid=node.cid, detail=detail)
     return node
 
 

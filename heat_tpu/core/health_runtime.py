@@ -252,6 +252,14 @@ def dump_flight(path: Optional[str] = None, reason: str = "manual") -> Dict[str,
         }
     except Exception as exc:
         bundle["memory"] = {"error": repr(exc)}
+    try:
+        from . import tracelens
+
+        # the one-page verdict over the ring window: forensics bundles ship
+        # a diagnosis, not just raw events (a ring is partial by construction)
+        bundle["diagnosis"] = tracelens.diagnose(evs)
+    except Exception as exc:
+        bundle["diagnosis"] = {"error": repr(exc)}
     with open(bundle_path, "w") as fh:
         json.dump(telemetry._jsonable(bundle), fh, indent=1, default=str)
         fh.write("\n")

@@ -107,6 +107,7 @@ import atexit
 import json
 import os
 import re
+import sys
 import threading
 import time
 import warnings
@@ -116,6 +117,7 @@ from typing import Any, Dict, List, Optional
 
 __all__ = [
     "RetraceWarning",
+    "TimelineDroppedWarning",
     "active",
     "async_forcing",
     "checkpoint_events",
@@ -182,6 +184,14 @@ class RetraceWarning(UserWarning):
     every miss pays a fresh XLA compile."""
 
 
+class TimelineDroppedWarning(UserWarning):
+    """The trace timeline hit its event cap and evicted the oldest events —
+    the recorded window is now TRUNCATED, and any analysis over it
+    (``tracelens.analyze``, exported traces) undercounts whatever happened
+    before the surviving suffix. One-shot per :func:`reset`; raise
+    ``HEAT_TPU_TELEMETRY_EVENTS`` to keep the whole window."""
+
+
 _OFF_VALUES = ("", "0", "false", "off", "no")
 
 
@@ -214,6 +224,10 @@ _RETRACE_WARN_AFTER = int(os.environ.get("HEAT_TPU_TELEMETRY_RETRACE_WARN", "8")
 #: the OLDEST events and counts them (``report()["timeline"]["events_dropped"]``)
 #: — truncation is visible, never silent.
 _EVENT_CAP = int(os.environ.get("HEAT_TPU_TELEMETRY_EVENTS", "8192"))
+
+#: one-shot latch for :class:`TimelineDroppedWarning` — the first cap
+#: eviction after a :func:`reset` warns loudly; subsequent drops only count
+_DROP_WARNED = False
 
 #: programs shown in ``report()["programs"]`` (ranked by dispatch count)
 _TOP_PROGRAMS = int(os.environ.get("HEAT_TPU_TELEMETRY_TOP_PROGRAMS", "5"))
@@ -324,6 +338,19 @@ class _State:
     def append_event(self, ev: dict) -> None:
         if self.events.maxlen is not None and len(self.events) == self.events.maxlen:
             self.events_dropped += 1
+            global _DROP_WARNED
+            if not _DROP_WARNED:
+                _DROP_WARNED = True
+                warnings.warn(
+                    "trace timeline hit its event cap "
+                    f"({self.events.maxlen}): oldest events are being dropped "
+                    "and the recorded window is truncated — raise "
+                    "HEAT_TPU_TELEMETRY_EVENTS to keep the whole window "
+                    "(tracelens refuses truncated windows without "
+                    "allow_partial)",
+                    TimelineDroppedWarning,
+                    stacklevel=3,
+                )
         self.events.append(ev)
 
 
@@ -426,6 +453,8 @@ def reset() -> None:
     block and the health block in, so a reset that left any stale would
     mislabel the next bench's report. The mode is left untouched; active
     :func:`scope`/:func:`span` stacks keep recording."""
+    global _DROP_WARNED
+    _DROP_WARNED = False
     for st in _STATES:
         st.clear()
     _SCOPES.clear()
@@ -661,6 +690,28 @@ def _in_trace() -> bool:
         return False
 
 
+#: sleep per recorded collective when the ``trace.hostdelay`` fault site is
+#: armed on this host — the straggler-attribution test seam
+_TRACE_DELAY_S = float(os.environ.get("HEAT_TPU_TRACE_DELAY_MS", "20")) / 1e3
+
+
+def _maybe_host_delay() -> None:
+    """Straggler test seam: when fault injection has armed the
+    ``trace.hostdelay`` site on THIS host (``resilience.inject``), every
+    collective record sleeps ``HEAT_TPU_TRACE_DELAY_MS`` before stamping its
+    event — one simulated slow worker whose cumulative lag the tracelens
+    straggler attribution must name. Looked up via ``sys.modules`` (never an
+    import: resilience imports this module) and gated on its armed flag, so
+    the cost is one dict probe when fault injection is idle."""
+    res = sys.modules.get("heat_tpu.core.resilience")
+    if res is None or not getattr(res, "_ARMED", False):
+        return
+    try:
+        res.check("trace.hostdelay")
+    except Exception:  # noqa: BLE001 - the raised fault IS the trigger
+        time.sleep(_TRACE_DELAY_S)
+
+
 def record_collective(
     op: str,
     axis: Optional[str] = None,
@@ -673,6 +724,7 @@ def record_collective(
     declared linalg schedules; no-op when telemetry is off."""
     if not _MODE:
         return
+    _maybe_host_delay()
     for st in _STATES:
         rec = st.collectives.get(op)
         if rec is None:
@@ -721,18 +773,23 @@ def collectives() -> Dict[str, Dict[str, Any]]:
     return _render_collectives(_cur())
 
 
-def record_fused_collective(kind: str, cid: Optional[int] = None) -> None:
+def record_fused_collective(
+    kind: str, cid: Optional[int] = None, detail: Optional[str] = None
+) -> None:
     """Count one collective NODE recorded into the fusion DAG (a deferred
     split-crossing reduction's psum, a deferred ``reshard``, a deferred
     ``apply:<kernel>``). These collectives execute INSIDE fused programs, so
     :func:`collective_counts` does not see them at dispatch time — this
     ledger counts them at record time, and ``fusion.program_hlo`` +
-    :func:`hlo_collective_counts` cross-check the compiled side."""
+    :func:`hlo_collective_counts` cross-check the compiled side. ``detail``
+    rides the timeline event only (e.g. a reshard's target split axis — what
+    the tracelens ping-pong detector keys on)."""
     if not _MODE:
         return
+    _maybe_host_delay()
     for st in _STATES:
         st.fused_collectives[kind] = st.fused_collectives.get(kind, 0) + 1
-    _note_event("fused_collective", op=kind, cid=cid)
+    _note_event("fused_collective", op=kind, cid=cid, detail=detail)
 
 
 def fused_collectives() -> Dict[str, int]:
@@ -1610,6 +1667,7 @@ def export_trace(path: Optional[str] = None, events: Optional[List[dict]] = None
             "tool": "heat_tpu.telemetry",
             "host": _host_index(),
             "mode": {0: "off", 1: "on", 2: "verbose"}[_MODE],
+            "events_dropped": _cur().events_dropped,
         },
     }
     if path is not None:
@@ -1639,9 +1697,16 @@ def merge_traces(
     collective its peers never recorded)."""
     merged: List[dict] = []
     seen_pids: set = set()
+    dropped_total = 0
     for i, p in enumerate(paths):
         with open(p) as fh:
             doc = json.load(fh)
+        other = doc.get("otherData")
+        if isinstance(other, dict):
+            try:
+                dropped_total += int(other.get("events_dropped") or 0)
+            except (TypeError, ValueError):
+                pass
         evs = doc.get("traceEvents", [])
         pids = {ev.get("pid", 0) for ev in evs}
         remap = {}
@@ -1662,7 +1727,11 @@ def merge_traces(
     doc = {
         "traceEvents": merged,
         "displayTimeUnit": "ms",
-        "otherData": {"tool": "heat_tpu.telemetry", "merged_from": len(paths)},
+        "otherData": {
+            "tool": "heat_tpu.telemetry",
+            "merged_from": len(paths),
+            "events_dropped": dropped_total,
+        },
     }
     if check_parity:
         problems = trace_collective_parity(doc)

@@ -8,6 +8,8 @@ exported Chrome/Perfetto trace files without writing any analysis code:
     $ python -m heat_tpu.telemetry show telemetry.json
     $ python -m heat_tpu.telemetry diff before.json after.json
     $ python -m heat_tpu.telemetry validate-trace trace.json
+    $ python -m heat_tpu.telemetry analyze trace.json           # tracelens verdict
+    $ python -m heat_tpu.telemetry analyze new.json --against old.json --json
     $ python -m heat_tpu.telemetry memory                 # live process ledger
     $ python -m heat_tpu.telemetry memory report.json --json
     $ python -m heat_tpu.telemetry health                 # flight/watchdog/SLO
@@ -422,6 +424,34 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
         "process's live health block (pure module state, no mesh bring-up)",
     )
     p_health.add_argument("--json", action="store_true", help="emit JSON instead of text")
+    p_ana = sub.add_parser(
+        "analyze",
+        help="tracelens diagnosis of a trace: time attribution per bucket, "
+        "critical path, cross-host straggler attribution, anti-pattern "
+        "findings; nonzero exit on warning/error findings or on regression "
+        "vs --against",
+    )
+    p_ana.add_argument(
+        "trace",
+        nargs="?",
+        default=None,
+        help="an export_trace/merge_traces file; omitted = THIS process's "
+        "live verbose timeline",
+    )
+    p_ana.add_argument(
+        "--against",
+        default=None,
+        help="baseline to diff against: a saved `analyze --json` output or "
+        "another trace file (bucket shifts, new findings, critical-path "
+        "growth; regressions exit 1)",
+    )
+    p_ana.add_argument("--json", action="store_true", help="emit JSON instead of text")
+    p_ana.add_argument(
+        "--allow-partial",
+        action="store_true",
+        help="analyze a window with dropped events anyway (attribution "
+        "undercounts the evicted prefix; refused with exit 2 otherwise)",
+    )
     p_val = sub.add_parser(
         "validate-trace", help="check a Chrome/Perfetto trace-event JSON file"
     )
@@ -459,6 +489,52 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
         else:
             _show_health(doc, out)
         return 0
+    if args.cmd == "analyze":
+        from heat_tpu.core import tracelens
+
+        try:
+            analysis = tracelens.analyze(args.trace, allow_partial=args.allow_partial)
+        except tracelens.TraceIncompleteError as exc:
+            print(f"REFUSED: {exc}", file=out)
+            return 2
+        except (ValueError, OSError) as exc:
+            print(f"ERROR: {exc}", file=out)
+            return 2
+        delta = None
+        if args.against is not None:
+            try:
+                baseline = tracelens.load_analysis(args.against)
+            except (ValueError, OSError) as exc:
+                print(f"ERROR: cannot load baseline: {exc}", file=out)
+                return 2
+            delta = tracelens.diff(baseline, analysis)
+        if args.json:
+            doc = dict(analysis)
+            if delta is not None:
+                doc["against"] = delta
+            print(json.dumps(_core._jsonable(doc), indent=2, sort_keys=True), file=out)
+        else:
+            print(tracelens.render(analysis), file=out)
+            if delta is not None:
+                shifts = delta["bucket_shifts_pts"]
+                if shifts:
+                    print("vs baseline (bucket shifts, pts):", file=out)
+                    for bucket, pts in sorted(shifts.items(), key=lambda kv: -abs(kv[1])):
+                        print(f"  {bucket:<16} {pts:+.2f}", file=out)
+                for f in delta["new_findings"]:
+                    print(
+                        f"NEW [{f.get('severity', '?')}] {f.get('rule')}: "
+                        f"{f.get('message')}",
+                        file=out,
+                    )
+                for r in delta["regressions"]:
+                    print(f"REGRESSION: {r}", file=out)
+        gate = any(
+            f.get("severity") in ("error", "warning") for f in analysis["findings"]
+        )
+        if delta is not None and not delta["ok"]:
+            gate = True
+        return 1 if gate else 0
     if args.cmd == "validate-trace":
         problems = _core.validate_trace(args.trace, cross_host=args.cross_host)
         if problems:

@@ -55,6 +55,22 @@ telemetry.export_trace("/tmp/heat_tpu_matrix_trace.json")
 PY
 HEAT_TPU_TELEMETRY=verbose \
   python -m heat_tpu.telemetry validate-trace /tmp/heat_tpu_matrix_trace.json
+# tracelens leg (ISSUE 13): the exported+validated trace runs through the
+# post-hoc analyzer — the JSON output must parse with full attribution
+# coverage (every bucket accounted, explicit unattributed remainder <= 5%)
+# and ZERO findings on this clean workload; `analyze` itself exits nonzero
+# on any warning/error finding, and the python step re-checks the shape
+python -m heat_tpu.telemetry analyze /tmp/heat_tpu_matrix_trace.json --json \
+  > /tmp/heat_tpu_matrix_analysis.json
+python - <<'PY'
+import json
+doc = json.load(open("/tmp/heat_tpu_matrix_analysis.json"))
+assert doc["attribution"]["overall"], "analyze produced no attribution buckets"
+assert doc["attribution"]["unattributed_pct"] <= 5.0, \
+    f"unattributed {doc['attribution']['unattributed_pct']}% > 5%"
+assert doc["findings"] == [], f"clean workload produced findings: {doc['findings']}"
+print("analyze OK:", {b: rec["pct"] for b, rec in doc["attribution"]["overall"].items()})
+PY
 # memory-observability leg: the headroom admission gate is ARMED (a generous
 # fraction of host memory under the warn policy — every fused dispatch pays
 # the live-ledger check without any policy actually firing) while the memory
@@ -77,7 +93,7 @@ HEAT_TPU_FAULTS=ci HEAT_TPU_TELEMETRY=1 \
   python -m pytest tests/test_resilience.py tests/test_resilience_io.py tests/test_io_errors.py \
     tests/test_checkpoint_resilience.py tests/test_checkpoint_profiling.py \
     tests/test_fused_collectives.py tests/test_trace_timeline.py \
-    tests/test_memory_obs.py -q -x
+    tests/test_memory_obs.py tests/test_tracelens.py -q -x
 # runtime-health leg (core/health_runtime.py): flight recorder ARMED with a
 # small ring and the stall watchdog live under the warn policy (every fused
 # dispatch and blocking sync pays the guard arm/disarm and the ring append)

@@ -746,6 +746,102 @@ def worker() -> None:
     except Exception:  # noqa: BLE001 - diagnostics must never cost the record
         pass
 
+    # numerics-lens leg (core/numlens.py, ISSUE 14): the lens's dispatch-rate
+    # cost in SAMPLE mode (per-dispatch hook check + every-16th stats kernel,
+    # shadow replay off for the rate gauge — contract <= 2%, banked as
+    # numlens_overhead_pct, paired rounds + median like the flight gauge),
+    # the shadow-replay drift ledger's worst ULP over a reorder-sensitive
+    # reduction battery (drift_max_ulp — how far XLA's fusion/reassociation
+    # moved the answer on this box), and the SDC canary's warm wall time
+    # (sdc_canary_ms). Runs AFTER the record is banked (hang-safety
+    # invariant).
+    try:
+        from heat_tpu.core import numlens as _numlens
+
+        if chain_fused:
+            _nn = (262144 // comm.size) * comm.size
+            _nk = jax.random.PRNGKey(9)
+            _na = ht.array(
+                jax.device_put(
+                    jax.random.normal(_nk, (_nn, 4), dtype=jnp.float32),
+                    comm.sharding(2, 0),
+                ),
+                is_split=0,
+            )
+            _nb = ht.array(
+                jax.device_put(
+                    jax.random.normal(_nk, (_nn, 4), dtype=jnp.float32),
+                    comm.sharding(2, 0),
+                ),
+                is_split=0,
+            )
+
+            def _numlens_chain_once():
+                c = ht.exp((_na + _nb) * 2.0) - _nb
+                d = ht.abs(c)
+                h = (ht.sqrt(ht.abs(d + _na)) / (d + 1.0)) * _nb
+                return float(ht.sum(h).larray)
+
+            def _numlens_chain_rate():
+                _numlens_chain_once()
+                start = time.perf_counter()
+                for _ in range(256):
+                    _numlens_chain_once()
+                return 2560.0 / (time.perf_counter() - start)
+
+            def _nl_median(xs):
+                xs = sorted(xs)
+                mid = len(xs) // 2
+                return xs[mid] if len(xs) % 2 else 0.5 * (xs[mid - 1] + xs[mid])
+
+            _prev_shadow = _numlens._SHADOW_EVERY
+            _prev_mode = _numlens.set_mode(0)
+            with _telemetry.enabled():
+                _numlens._SHADOW_EVERY = 0  # rate gauge: stats only, no replay
+                overheads = []
+                try:
+                    for _ in range(9):
+                        _numlens.set_mode(0)
+                        n_off = _numlens_chain_rate()
+                        _numlens.set_mode("sample")
+                        if n_off:
+                            overheads.append(
+                                100.0 * (1.0 - _numlens_chain_rate() / n_off)
+                            )
+                finally:
+                    _numlens._SHADOW_EVERY = _prev_shadow
+                    _numlens.set_mode(_prev_mode)
+            if overheads:
+                record["numlens_overhead_pct"] = round(_nl_median(overheads), 1)
+            # drift ledger: full mode, shadow every sampled dispatch, over a
+            # reduction battery whose fused programs reassociate (split-axis
+            # psums + tree reductions) — the eager replay orders them
+            # differently, so max_ulp is the real fused-vs-eager drift
+            _numlens.set_mode("full")
+            _numlens._SHADOW_EVERY = 1
+            try:
+                _dr = ht.array(
+                    jax.device_put(
+                        jax.random.normal(_nk, (4096, 32), dtype=jnp.float32),
+                        comm.sharding(2, 0),
+                    ),
+                    is_split=0,
+                )
+                float(ht.sum((_dr / 3.0).sum(axis=1)))
+                float(ht.std(_dr * _dr + 1.0))
+                float(ht.mean(ht.exp(_dr * 0.1) * _dr))
+                record["drift_max_ulp"] = int(_numlens.drift_ledger()["max_ulp"])
+                _numlens.run_canary()  # warm: compiles the per-device probe
+                _canary = _numlens.run_canary()
+                if _canary is not None:
+                    record["sdc_canary_ms"] = round(_canary["ms"], 2)
+            finally:
+                _numlens._SHADOW_EVERY = _prev_shadow
+                _numlens.set_mode(_prev_mode)
+            print(json.dumps(record), flush=True)  # last parseable line wins
+    except Exception:  # noqa: BLE001 - diagnostics must never cost the record
+        pass
+
     # static-analysis leg (heat_tpu/analysis, ISSUE 7): the AST lint's wall
     # time over the library (the pre-commit budget a CI hook would pay) and
     # the AOT program auditor's finding count over the program cache the
@@ -1321,6 +1417,7 @@ _OVERHEAD_CEILINGS = {
     "flight_overhead_pct": 2.0,
     "memory_ledger_overhead_pct": 5.0,
     "guarded_dispatch_overhead_pct": 10.0,
+    "numlens_overhead_pct": 2.0,
 }
 
 #: static-analysis counters that must never grow between rounds
@@ -1340,6 +1437,16 @@ _TRACELENS_CEILINGS = {
 #: scheduler noise on sub-ms segments, not license to decay)
 _QUALITY_CEILINGS = {
     "unattributed_time_pct": 5.0,
+}
+
+#: numerics-lens gauges with absolute ceilings: the shadow-replay drift of
+#: the reduction battery (ULPs of fused-vs-eager reassociation — a compiler
+#: property, stable per box; a jump means XLA started reordering harder or
+#: the replay broke) and the SDC canary's warm wall time; same
+#: ``max(ceiling, banked*1.5+2.0)`` noise logic as the overhead gauges
+_NUMLENS_CEILINGS = {
+    "drift_max_ulp": 4096.0,
+    "sdc_canary_ms": 2000.0,
 }
 
 #: elastic-recovery costs with absolute ceilings (lower is better; the
@@ -1417,6 +1524,18 @@ def compare_records(fresh: dict, banked: dict, slack: float = 0.30) -> dict:
                 f"(ceiling {ceiling:g}%, banked {b if b is not None else 'n/a'})"
             )
     for key, ceiling in _ELASTIC_CEILINGS.items():
+        f, b = _num(fresh, key), _num(banked, key)
+        if f is None:
+            if b is not None:
+                notes.append(f"{key}: banked={b:g} but missing from fresh record")
+            continue
+        limit = ceiling if b is None else max(ceiling, b * 1.5 + 2.0)
+        if f > limit:
+            regressions.append(
+                f"{key}: fresh {f:g} > limit {limit:g} "
+                f"(ceiling {ceiling:g}, banked {b if b is not None else 'n/a'})"
+            )
+    for key, ceiling in _NUMLENS_CEILINGS.items():
         f, b = _num(fresh, key), _num(banked, key)
         if f is None:
             if b is not None:

@@ -27,6 +27,7 @@ from .core import (
     manipulations,
     memledger,
     memory,
+    numlens,
     printing,
     relational,
     resilience,

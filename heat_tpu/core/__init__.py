@@ -22,6 +22,7 @@ from .resilience import errstate
 from . import memledger
 from . import health_runtime
 from . import tracelens
+from . import numlens
 from . import fusion
 from . import elastic
 from .dndarray import *

@@ -219,6 +219,7 @@ class DNDarray:
         linalg, the eager engine fallbacks) funnels through this property."""
         arr = self.__array
         if isinstance(arr, fusion.LazyArray):
+            lazy = arr
             arr = fusion.force(arr)
             if isinstance(arr, jax.core.Tracer):
                 # forced inside an enclosing trace: the value belongs to that
@@ -238,7 +239,13 @@ class DNDarray:
                     idx = [slice(None)] * arr.ndim
                     idx[split] = slice(0, self.__gshape[split])
                     check_val = arr[tuple(idx)]
-                resilience.check_nonfinite(check_val, "force")
+                # provenance: the fused program key stamped on the root at
+                # force time + the chain's correlation id — a nonfinite
+                # finding names its producer, not just the catch point
+                resilience.check_nonfinite(
+                    check_val, "force",
+                    program=getattr(lazy, "program", None), cid=lazy.cid,
+                )
             arr = _ensure_split(arr, split, self.__comm)
             self.__array = arr
             # re-attribute the forced value: the async future ("fusion")

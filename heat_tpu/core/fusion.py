@@ -240,10 +240,15 @@ class LazyArray:
     tuple of static keyword arguments baked into the program. ``shape`` /
     ``dtype`` describe the *physical* result (inferred abstractly at record
     time, never by executing the op). ``cid`` is the chain's correlation id
-    (inherited from the first still-pending child, else fresh).
+    (inherited from the first still-pending child, else fresh). ``program``
+    is stamped at force time with the key of the fused program that
+    produced the value (None while pending, or when the chain degraded to
+    eager replay) — the provenance ``ht.errstate`` and the numerics lens
+    report for a value gone bad.
     """
 
-    __slots__ = ("fn", "children", "kw", "shape", "dtype", "depth", "cid", "_value")
+    __slots__ = ("fn", "children", "kw", "shape", "dtype", "depth", "cid",
+                 "program", "_value")
 
     def __init__(self, fn, children, kw, shape, dtype, depth, cid=0):
         self.fn = fn
@@ -253,6 +258,7 @@ class LazyArray:
         self.dtype = dtype
         self.depth = depth
         self.cid = cid
+        self.program = None
         self._value = None
 
     @property
@@ -866,6 +872,10 @@ def force(node):
     for root, value in zip(roots, values):
         if not isinstance(value, jax.core.Tracer):
             root._value = value
+            # provenance stamp: which fused program produced this value
+            # (None on the degraded eager path) — read back at the errstate
+            # seam so a nonfinite finding can name its producer
+            root.program = None if info is None else info["key"]
             # drop the recorded graph: later forces of ancestors treat this
             # node as a leaf, and the chain's operand buffers become
             # collectable
@@ -875,6 +885,12 @@ def force(node):
             # BEFORE the dispatch event below, whose ledger sample must see
             # the in-flight futures attributed, not "unattributed"
             memledger.tag(value, "fusion")
+    if telemetry._NUMLENS_HOOK is not None and info is not None:
+        # numerics lens (core/numlens.py, HEAT_TPU_NUMLENS): sampled tensor
+        # statistics + shadow-replay drift audit over the landed root
+        # values — one attribute check when disarmed, and the hook itself
+        # never raises and skips tracer values
+        telemetry._NUMLENS_HOOK(sig, leaves, roots, values, info)
     if telemetry._MODE:
         telemetry.record_async_dispatch(
             len(roots),

@@ -260,6 +260,20 @@ def dump_flight(path: Optional[str] = None, reason: str = "manual") -> Dict[str,
         bundle["diagnosis"] = tracelens.diagnose(evs)
     except Exception as exc:
         bundle["diagnosis"] = {"error": repr(exc)}
+    try:
+        from . import numlens
+
+        # the value-plane evidence rides next to the diagnosis: the last
+        # numeric findings (SDC hits, drift breaches, nonfinite provenance)
+        # plus the drift ledger, so a post-mortem can tell "the runtime
+        # stalled" apart from "the numbers went bad first"
+        bundle["numerics"] = {
+            "findings": numlens.findings(),
+            "drift": numlens.drift_ledger(),
+            "canary": numlens.numerics_block()["canary"],
+        }
+    except Exception as exc:
+        bundle["numerics"] = {"error": repr(exc)}
     with open(bundle_path, "w") as fh:
         json.dump(telemetry._jsonable(bundle), fh, indent=1, default=str)
         fh.write("\n")

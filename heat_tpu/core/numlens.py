@@ -1,0 +1,752 @@
+"""Numerics observability — the value-plane companion to the time/memory/
+health planes (``telemetry``/``memledger``/``health_runtime``).
+
+The observability stack watches *when* things run, *what* they allocate and
+*whether* the runtime is healthy — but nothing watches the **values**: a
+bf16 underflow collapsing a gradient, a fused-reduction reorder drifting
+past tolerance, or a flaky accelerator returning silently-wrong bits is
+invisible until a model diverges. This module closes that gap with four
+pillars, all off by default (``HEAT_TPU_NUMLENS={0,sample,full}``) and
+near-zero when disabled (the fused-dispatch seam pays exactly one ``is
+None`` check on ``telemetry._NUMLENS_HOOK``):
+
+1. **Streaming tensor statistics** — every Nth fused dispatch
+   (``HEAT_TPU_NUMLENS_SAMPLE_EVERY``; ``full`` samples every dispatch)
+   runs a tiny jitted stats program over each DAG-root value: rms, absmax,
+   nonfinite count, subnormal fraction and a fixed 16-bucket exponent
+   histogram spanning the value dtype's exponent range (the edge buckets
+   are the underflow/overflow saturation gauges EQuARX per-block scales
+   will pin against). Aggregated per program key + root into
+   ``report()["numerics"]``, emitted as ``numeric`` timeline events and
+   exported as Perfetto counter tracks alongside memledger's.
+
+2. **Shadow-replay drift audit** — every ``HEAT_TPU_NUMLENS_SHADOW_EVERY``
+   sampled dispatch is re-executed through the fusion engine's bitwise
+   eager replay path (``fusion._build(sig)`` — the exact unjitted op chain
+   the degrade path runs) and compared ULP-aware against the fused jitted
+   output. The per-program drift ledger (p50/max ULP, worst op family)
+   machine-checks the "fused reorder stays within float tolerance"
+   contract the tests otherwise pin only at fixed shapes.
+
+3. **Cross-device determinism + SDC sentinel** — :func:`run_canary` runs a
+   fixed jitted program with replicated inputs on every mesh device,
+   twice per device: repeated executions must be bitwise self-consistent
+   and all devices must agree with the majority (across hosts, process
+   rank disambiguates the device names). A mismatch emits a
+   ``numlens.sdc`` finding naming the sick device and feeds
+   ``resilience.note_device_fault``, escalating through the quarantine
+   ledger into a mesh shrink — silent data corruption becomes a
+   diagnosed, self-healing event. The ``numeric.sdc.<index>`` fault site
+   gives every detector a true-positive test.
+
+4. **Training-signal telemetry** — DASO and ``nn.data_parallel`` call
+   :func:`note_training` around each gradient sync: per-merge
+   gradient/update norms, the update ratio ``|Δp|/|p|``, and plateau /
+   overflow detectors over the loss stream (the assertable surface
+   EQuARX error-feedback parity pins against).
+
+Contracts shared with the other observability planes: never force a
+pending chain, never initialize a JAX backend from a pure-state read
+(:func:`numerics_block` is module state only; :func:`run_canary` returns
+``None`` until the mesh singleton exists), never raise out of the hook.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from collections import Counter, OrderedDict, deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import resilience, telemetry
+
+__all__ = [
+    "set_mode",
+    "mode",
+    "active",
+    "reset",
+    "numerics_block",
+    "tensor_stats",
+    "drift_ledger",
+    "run_canary",
+    "note_training",
+    "training_stats",
+    "findings",
+    "ulp_diff",
+]
+
+# ----------------------------------------------------------------------
+# knobs
+# ----------------------------------------------------------------------
+_MODE_NAMES = {0: "off", 1: "sample", 2: "full"}
+
+
+def _parse_mode(raw) -> int:
+    if isinstance(raw, int):
+        return max(0, min(2, raw))
+    s = str(raw or "").strip().lower()
+    if s in ("", "0", "off", "false", "no"):
+        return 0
+    if s in ("2", "full"):
+        return 2
+    return 1  # "sample", "1", "on", anything truthy
+
+
+def _int_env(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+_MODE = 0
+#: sample every Nth fused dispatch in ``sample`` mode (``full`` ignores it)
+_SAMPLE_EVERY = _int_env("HEAT_TPU_NUMLENS_SAMPLE_EVERY", 16)
+#: shadow-replay every Nth SAMPLED dispatch (0 disables the drift audit)
+_SHADOW_EVERY = _int_env("HEAT_TPU_NUMLENS_SHADOW_EVERY", 4)
+#: run the SDC canary every Nth sampled dispatch (0 = manual run_canary only)
+_CANARY_EVERY = _int_env("HEAT_TPU_NUMLENS_CANARY_EVERY", 0)
+#: drift above this many ULPs raises a ``numlens.drift`` finding
+_MAX_ULP = _int_env("HEAT_TPU_NUMLENS_MAX_ULP", 16)
+
+#: fixed exponent-histogram width — the bf16/f16 saturation tests and the
+#: CLI renderer assume this is stable
+N_BUCKETS = 16
+
+_FINDING_CAP = 64
+_PROGRAM_CAP = 64
+_TRAIN_WINDOW = 128
+_PLATEAU_WINDOW = 12
+
+# ----------------------------------------------------------------------
+# session state (cleared by reset(); the mode survives, like memledger's
+# budget arming — arming is configuration, counters are session data)
+# ----------------------------------------------------------------------
+_LOCK = threading.Lock()
+_SEEN = 0  # fused dispatches observed while armed
+_SAMPLED = 0  # dispatches that actually paid for stats
+_STATS: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+_DRIFT: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+_CANARY: Dict[str, Any] = {}
+_TRAINING: Dict[str, Dict[str, Any]] = {}
+_FINDINGS: deque = deque(maxlen=_FINDING_CAP)
+_IN_HOOK = False
+
+
+def mode() -> str:
+    """Current mode name: ``off`` / ``sample`` / ``full``."""
+    return _MODE_NAMES[_MODE]
+
+
+def active() -> bool:
+    """Whether the numerics lens is armed (sampling hook installed)."""
+    return _MODE > 0
+
+
+def set_mode(new_mode) -> int:
+    """Arm or disarm the lens; accepts ``0/"off"``, ``1/"sample"``,
+    ``2/"full"`` (or a previous return value). Installs the fused-dispatch
+    hook as a telemetry module attribute (the ``_MEM_HOOK`` set-attribute
+    pattern) so the disabled hot path costs one ``is None`` check. Returns
+    the previous mode as an int so callers can restore it."""
+    global _MODE
+    prev = _MODE
+    _MODE = _parse_mode(new_mode)
+    telemetry._NUMLENS_HOOK = _on_dispatch if _MODE else None
+    return prev
+
+
+def reset() -> None:
+    """Clear the session state (counters, stats, drift ledger, canary,
+    training streams, findings). The mode/knobs survive — arming is
+    configuration, mirrored on ``memledger.reset``. Called from
+    ``telemetry.reset()`` so the joined report surfaces clear together."""
+    global _SEEN, _SAMPLED
+    with _LOCK:
+        _SEEN = 0
+        _SAMPLED = 0
+        _STATS.clear()
+        _DRIFT.clear()
+        _CANARY.clear()
+        _TRAINING.clear()
+        _FINDINGS.clear()
+
+
+def _add_finding(rule: str, severity: str, message: str, **data) -> Dict[str, Any]:
+    f = {"rule": rule, "severity": severity, "message": message}
+    f.update(data)
+    _FINDINGS.append(f)
+    return f
+
+
+def findings() -> List[Dict[str, Any]]:
+    """The capped list of numeric findings (drift breaches, SDC hits,
+    training overflow/plateau), oldest first."""
+    return list(_FINDINGS)
+
+
+# ----------------------------------------------------------------------
+# pillar 1: streaming tensor statistics
+# ----------------------------------------------------------------------
+_STATS_FNS: Dict[str, Any] = {}
+
+
+#: IEEE exponent-field widths per dtype — the count/histogram pillar works
+#: on raw bit patterns: float comparisons on subnormal operands are
+#: flushed to zero by some XLA CPU pipelines (FTZ varies per compiled
+#: program, observed on jax 0.4.37), so only the bits are trustworthy
+_EXP_BITS = {"bfloat16": 8, "float16": 5, "float32": 8, "float64": 11}
+
+
+def _stats_fn(dtype):
+    """One tiny jitted stats program per float dtype: (rms, absmax,
+    nonfinite count, subnormal count, exponent histogram). The counts and
+    the histogram are computed from the integer bit pattern (exponent /
+    mantissa fields) — exact and flush-proof; rms/absmax use float math
+    with the sum-of-squares scaled by absmax so bf16-range maxima do not
+    overflow the f32 accumulator."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    key = str(np.dtype(dtype))
+    fn = _STATS_FNS.get(key)
+    if fn is not None:
+        return fn
+    nbits = np.dtype(dtype).itemsize * 8
+    uint = {16: jnp.uint16, 32: jnp.uint32, 64: jnp.uint64}[nbits]
+    ebits = _EXP_BITS[key]
+    mbits = nbits - 1 - ebits
+    bias = (1 << (ebits - 1)) - 1
+    efield_max = (1 << ebits) - 1
+    minexp = 1 - bias  # floor(log2) of the smallest normal
+    span = max(1, efield_max - 1)  # number of normal exponent codes
+
+    def stats(x):
+        xr = jnp.ravel(x)
+        bits = lax.bitcast_convert_type(xr, uint)
+        expf = ((bits >> mbits) & efield_max).astype(jnp.int32)
+        mant = bits & ((1 << mbits) - 1)
+        is_nonfinite = expf == efield_max  # inf and nan: exponent all-ones
+        is_zero = (expf == 0) & (mant == 0)
+        nonfinite = jnp.sum(is_nonfinite)
+        subnormal = jnp.sum((expf == 0) & (mant != 0))
+        # bucket by floor(log2|x|) = biased exponent - bias, over the
+        # dtype's own exponent range; subnormals fall below minexp and
+        # clip into bucket 0 (the underflow-saturation gauge)
+        e = expf - bias
+        b = jnp.clip(((e - minexp) * N_BUCKETS) // span, 0, N_BUCKETS - 1)
+        counted = (~is_nonfinite) & (~is_zero)
+        hist = jnp.bincount(b, weights=counted.astype(jnp.int32), length=N_BUCKETS)
+        acc = jnp.float64 if x.dtype == jnp.float64 else jnp.float32
+        xf = jnp.where(is_nonfinite, 0, xr).astype(acc)
+        ax = jnp.abs(xf)
+        absmax = jnp.max(ax)
+        scale = jnp.maximum(absmax, jnp.asarray(1e-30, acc))
+        rms = scale * jnp.sqrt(jnp.mean(jnp.square(xf / scale)))
+        return rms, absmax, nonfinite, subnormal, hist
+
+    fn = jax.jit(stats)
+    _STATS_FNS[key] = fn
+    return fn
+
+
+def _record_stats(key: str, family: str, values, roots) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    rec = _STATS.get(key)
+    if rec is None:
+        while len(_STATS) >= _PROGRAM_CAP:
+            _STATS.popitem(last=False)
+        rec = _STATS[key] = {"family": family, "samples": 0, "roots": {}}
+    rec["samples"] += 1
+    for i, v in enumerate(values):
+        dt = getattr(v, "dtype", None)
+        if dt is None or not jnp.issubdtype(dt, jnp.floating):
+            continue
+        rms, absmax, nonfinite, subnormal, hist = jax.device_get(_stats_fn(dt)(v))
+        n = int(v.size)
+        hist = np.asarray(hist, dtype=np.int64)
+        rr = rec["roots"].get(i)
+        if rr is None:
+            rr = rec["roots"][i] = {
+                "shape": tuple(getattr(v, "shape", ())),
+                "dtype": str(np.dtype(dt)),
+                "samples": 0,
+                "elems": 0,
+                "rms": 0.0,
+                "absmax": 0.0,
+                "nonfinite": 0,
+                "subnormal": 0,
+                "subnormal_pct": 0.0,
+                "hist": [0] * N_BUCKETS,
+                "edge_low": 0,
+                "edge_high": 0,
+            }
+        rr["samples"] += 1
+        rr["elems"] += n
+        rr["rms"] = float(rms)
+        rr["absmax"] = max(rr["absmax"], float(absmax))
+        rr["nonfinite"] += int(nonfinite)
+        rr["subnormal"] += int(subnormal)
+        rr["subnormal_pct"] = round(100.0 * rr["subnormal"] / max(1, rr["elems"]), 4)
+        rr["hist"] = [a + int(b) for a, b in zip(rr["hist"], hist)]
+        rr["edge_low"] = rr["hist"][0]
+        rr["edge_high"] = rr["hist"][-1]
+        telemetry.record_event(
+            "numeric",
+            event="stats",
+            program=key,
+            root=i,
+            dtype=rr["dtype"],
+            rms=float(rms),
+            absmax=float(absmax),
+            nonfinite=int(nonfinite),
+            subnormal_pct=rr["subnormal_pct"],
+            edge_low=int(hist[0]),
+            edge_high=int(hist[-1]),
+        )
+
+
+def tensor_stats() -> Dict[str, Dict[str, Any]]:
+    """Per-program-key streaming statistics (per DAG root: rms, absmax,
+    nonfinite/subnormal counts, exponent histogram + edge saturation)."""
+    with _LOCK:
+        return {k: _copy_stats(v) for k, v in _STATS.items()}
+
+
+def _copy_stats(rec: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(rec)
+    out["roots"] = {i: dict(rr) for i, rr in rec["roots"].items()}
+    return out
+
+
+# ----------------------------------------------------------------------
+# pillar 2: ULP-aware shadow-replay drift audit
+# ----------------------------------------------------------------------
+_INT_FOR_SIZE = {2: np.int16, 4: np.int32, 8: np.int64}
+_ULP_SENTINEL = 2**62  # stands in for "finiteness disagrees"
+
+
+def ulp_diff(a, b) -> np.ndarray:
+    """Elementwise ULP distance between two same-dtype float arrays (bf16 /
+    f16 / f32 / f64), as int64. Bit patterns are mapped through the
+    monotone signed-magnitude transform (``i if i >= 0 else INT_MIN - i``,
+    so -0.0 and +0.0 coincide) and differenced in float64 (exact for any
+    drift small enough to matter, overflow-safe at the extremes). Elements
+    where both sides are nonfinite count as 0; where finiteness disagrees
+    the distance saturates at ``2**62``."""
+    a = np.atleast_1d(np.asarray(a))
+    b = np.atleast_1d(np.asarray(b))
+    if a.dtype != b.dtype:
+        raise TypeError(f"ulp_diff needs matching dtypes, got {a.dtype} vs {b.dtype}")
+    itype = _INT_FOR_SIZE.get(a.dtype.itemsize)
+    if itype is None or a.dtype.kind in "iub?":
+        raise TypeError(f"ulp_diff: unsupported dtype {a.dtype}")
+    ia = a.view(itype).astype(np.int64)
+    ib = b.view(itype).astype(np.int64)
+    mn = -(2 ** (8 * a.dtype.itemsize - 1))
+    oa = np.where(ia >= 0, ia, mn - ia).astype(np.float64)
+    ob = np.where(ib >= 0, ib, mn - ib).astype(np.float64)
+    d = np.minimum(np.abs(oa - ob), float(_ULP_SENTINEL)).astype(np.int64)
+    fa = np.isfinite(a.astype(np.float64))
+    fb = np.isfinite(b.astype(np.float64))
+    d = np.where(fa & fb, d, np.where(fa == fb, 0, _ULP_SENTINEL))
+    return d
+
+
+def _shadow_audit(sig, leaves, values, info) -> None:
+    """Re-execute the fused program through the eager bitwise replay path
+    (``fusion._build`` — exactly what the degrade path runs op by op,
+    outside jit) and compare each root ULP-aware against the fused jitted
+    output. This prices one eager chain execution per
+    ``sample_every * shadow_every`` dispatches."""
+    import jax.numpy as jnp
+
+    from . import fusion
+
+    replay = fusion._build(sig)(*leaves)
+    key, family = info["key"], info.get("family", "?")
+    diffs: List[np.ndarray] = []
+    mismatched = 0
+    for v, r in zip(values, replay):
+        dt = getattr(v, "dtype", None)
+        if dt is None or not jnp.issubdtype(dt, jnp.floating):
+            continue
+        try:
+            d = ulp_diff(np.asarray(v), np.asarray(r))
+        except TypeError:
+            continue
+        diffs.append(d.ravel())
+        mismatched += int(np.count_nonzero(d >= _ULP_SENTINEL))
+    if not diffs:
+        return
+    flat = np.concatenate(diffs)
+    p50 = int(np.median(flat))
+    worst = int(flat.max())
+    rec = _DRIFT.get(key)
+    if rec is None:
+        while len(_DRIFT) >= _PROGRAM_CAP:
+            _DRIFT.popitem(last=False)
+        rec = _DRIFT[key] = {
+            "family": family,
+            "samples": 0,
+            "p50_ulp": 0,
+            "max_ulp": 0,
+            "nonfinite_mismatch": 0,
+        }
+    rec["samples"] += 1
+    rec["p50_ulp"] = max(rec["p50_ulp"], p50)
+    rec["max_ulp"] = max(rec["max_ulp"], worst)
+    rec["nonfinite_mismatch"] += mismatched
+    telemetry.record_event(
+        "numeric", event="drift", program=key, family=family,
+        p50_ulp=p50, max_ulp=worst,
+    )
+    if worst > _MAX_ULP:
+        _add_finding(
+            "numlens.drift",
+            "warning",
+            f"shadow replay of program {key} (family {family}) drifted "
+            f"{worst} ULP from the fused output (p50 {p50}, threshold "
+            f"{_MAX_ULP}) — the fused reorder left float tolerance",
+            program=key,
+            family=family,
+            max_ulp=worst,
+        )
+
+
+def drift_ledger() -> Dict[str, Any]:
+    """The shadow-replay drift picture: per-program (samples, p50/max ULP,
+    op family) plus the global worst offender."""
+    with _LOCK:
+        programs = {k: dict(v) for k, v in _DRIFT.items()}
+    worst_key, worst = None, -1
+    for k, v in programs.items():
+        if v["max_ulp"] > worst:
+            worst_key, worst = k, v["max_ulp"]
+    return {
+        "programs": programs,
+        "max_ulp": max(worst, 0),
+        "worst_program": worst_key,
+        "worst_family": programs[worst_key]["family"] if worst_key else None,
+    }
+
+
+# ----------------------------------------------------------------------
+# pillar 3: cross-device determinism canary / SDC sentinel
+# ----------------------------------------------------------------------
+_CANARY_FN = None
+
+
+def _canary_fn():
+    global _CANARY_FN
+    if _CANARY_FN is None:
+        import jax
+        import jax.numpy as jnp
+
+        # reorder-sensitive enough to catch a sick FPU, tiny enough to be
+        # microseconds per device: transcendental chain + full reduction
+        _CANARY_FN = jax.jit(
+            lambda x: jnp.sum(jnp.exp(jnp.sin(x) * 1.5) * x + jnp.sqrt(jnp.abs(x)))
+        )
+    return _CANARY_FN
+
+
+def run_canary(repeats: int = 2) -> Optional[Dict[str, Any]]:
+    """Run the determinism canary on every device of the live mesh:
+    replicated inputs, ``repeats`` executions per device. Each device must
+    be bitwise self-consistent across repeats and must agree with the
+    majority answer across devices (on a multi-host mesh the addressable
+    devices of every process run the same check against the same constant
+    input, so a cross-host divergence surfaces as a majority mismatch on
+    the host that owns the sick chip). A mismatch emits a ``numlens.sdc``
+    finding naming the device and feeds ``resilience.note_device_fault`` —
+    three strikes quarantines the device and the elastic supervisor
+    shrinks the mesh around it. Returns ``None`` without touching JAX when
+    no mesh exists yet (the never-initialize contract); the
+    ``numeric.sdc.<index>`` fault site injects a corruption per device
+    index for true-positive tests."""
+    from . import communication
+
+    comm = communication.MESH_WORLD
+    if comm is None:
+        return None
+    import jax
+
+    t0 = time.perf_counter()
+    x = (np.arange(96, dtype=np.float32) * 0.37) - 11.5
+    prog = _canary_fn()
+    outs: Dict[int, Optional[bytes]] = {}
+    sick: Dict[int, str] = {}
+    for idx, dev in enumerate(comm.devices):
+        try:
+            resilience.check(f"numeric.sdc.{idx}")
+            got = []
+            for _ in range(max(2, int(repeats))):
+                y = jax.device_get(prog(jax.device_put(x, dev)))
+                got.append(np.atleast_1d(np.asarray(y)).tobytes())
+            if any(g != got[0] for g in got[1:]):
+                sick[idx] = "self-inconsistent across repeats"
+                outs[idx] = None
+            else:
+                outs[idx] = got[0]
+        except resilience.FaultInjected:
+            sick[idx] = "injected numeric.sdc corruption"
+            outs[idx] = None
+    majority = None
+    votes = Counter(v for v in outs.values() if v is not None)
+    if votes:
+        majority = votes.most_common(1)[0][0]
+        for idx, v in outs.items():
+            if v is not None and v != majority and idx not in sick:
+                sick[idx] = "bitwise mismatch vs device majority"
+    ms = (time.perf_counter() - t0) * 1e3
+    mismatches = []
+    devs = list(comm.devices)
+    for idx in sorted(sick):
+        dev = devs[idx]
+        why = sick[idx]
+        mismatches.append(str(dev))
+        _add_finding(
+            "numlens.sdc",
+            "error",
+            f"SDC canary: device {dev} (index {idx}) returned wrong bits "
+            f"({why}) — replicated input must agree bitwise; reporting to "
+            f"the resilience quarantine ledger",
+            device=str(dev),
+            index=idx,
+            why=why,
+        )
+        telemetry.record_event(
+            "numeric", event="sdc", device=str(dev), index=idx, why=why
+        )
+        try:
+            resilience.note_device_fault(dev, site="numlens.sdc")
+        except Exception:  # pragma: no cover - ledger must not kill the canary
+            pass
+    with _LOCK:
+        _CANARY["runs"] = _CANARY.get("runs", 0) + 1
+        _CANARY["devices"] = len(devs)
+        _CANARY["mismatches"] = _CANARY.get("mismatches", 0) + len(mismatches)
+        _CANARY["last_ms"] = round(ms, 3)
+        _CANARY["last_sick"] = mismatches
+    return {"devices": len(devs), "mismatches": mismatches, "ms": ms}
+
+
+# ----------------------------------------------------------------------
+# pillar 4: training-signal telemetry (DASO / nn.data_parallel seam)
+# ----------------------------------------------------------------------
+def _tree_norm(tree) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    leaves = [l for l in jax.tree_util.tree_leaves(tree) if hasattr(l, "dtype")]
+    if not leaves:
+        return 0.0
+    total = sum(jnp.sum(jnp.square(jnp.asarray(l, jnp.float32))) for l in leaves)
+    return float(jnp.sqrt(total))
+
+
+def note_training(
+    tag: str,
+    *,
+    loss=None,
+    params=None,
+    prev_params=None,
+    grads=None,
+) -> Optional[Dict[str, Any]]:
+    """Record one gradient-sync / merge step for stream ``tag``: loss,
+    gradient norm (when ``grads`` given), parameter norm, update norm
+    ``|params - prev_params|`` and the update ratio ``|Δp| / |p|``. Flags
+    ``numlens.overflow`` (nonfinite loss or update) and ``numlens.plateau``
+    (loss flat over the last window). No-op returning None when the lens is
+    off — callers gate on a single module-attr read."""
+    if not _MODE:
+        return None
+    try:
+        import jax
+
+        rec = _TRAINING.get(tag)
+        if rec is None:
+            rec = _TRAINING[tag] = {
+                "steps": 0,
+                "losses": deque(maxlen=_TRAIN_WINDOW),
+                "grad_norms": deque(maxlen=_TRAIN_WINDOW),
+                "update_ratios": deque(maxlen=_TRAIN_WINDOW),
+                "overflows": 0,
+                "plateau": False,
+            }
+        rec["steps"] += 1
+        out: Dict[str, Any] = {"tag": tag, "step": rec["steps"]}
+        loss_f = None
+        if loss is not None:
+            try:
+                loss_f = float(jax.device_get(loss))
+            except Exception:
+                loss_f = None
+        if loss_f is not None:
+            rec["losses"].append(loss_f)
+            out["loss"] = loss_f
+        if grads is not None:
+            gn = _tree_norm(grads)
+            rec["grad_norms"].append(gn)
+            out["grad_norm"] = gn
+        if params is not None and prev_params is not None:
+            import jax.numpy as jnp
+
+            delta = jax.tree_util.tree_map(
+                lambda a, b: jnp.asarray(a, jnp.float32) - jnp.asarray(b, jnp.float32),
+                params,
+                prev_params,
+            )
+            un = _tree_norm(delta)
+            pn = _tree_norm(params)
+            ratio = un / (pn + 1e-12)
+            rec["update_ratios"].append(ratio)
+            out["update_norm"] = un
+            out["param_norm"] = pn
+            out["update_ratio"] = ratio
+        # overflow: a nonfinite loss or update is an error finding per hit
+        bad_loss = loss_f is not None and not math.isfinite(loss_f)
+        bad_update = "update_norm" in out and not math.isfinite(out["update_norm"])
+        if bad_loss or bad_update:
+            rec["overflows"] += 1
+            what = "loss" if bad_loss else "parameter update"
+            _add_finding(
+                "numlens.overflow",
+                "error",
+                f"training stream '{tag}' step {rec['steps']}: nonfinite "
+                f"{what} — gradients have overflowed",
+                tag=tag,
+                step=rec["steps"],
+            )
+        # plateau: loss flat (relative) over the detection window; flagged
+        # once, rearmed when the loss moves again
+        losses = list(rec["losses"])
+        if len(losses) >= _PLATEAU_WINDOW:
+            win = losses[-_PLATEAU_WINDOW:]
+            if all(math.isfinite(v) for v in win):
+                spread = max(win) - min(win)
+                scale = max(1e-12, abs(sum(win) / len(win)))
+                flat = spread <= 1e-9 + 1e-6 * scale
+                if flat and not rec["plateau"]:
+                    rec["plateau"] = True
+                    _add_finding(
+                        "numlens.plateau",
+                        "info",
+                        f"training stream '{tag}' loss has been flat for "
+                        f"{_PLATEAU_WINDOW} merges (spread {spread:.3e}) — "
+                        f"plateau or dead gradients",
+                        tag=tag,
+                        step=rec["steps"],
+                    )
+                elif not flat:
+                    rec["plateau"] = False
+        telemetry.record_event(
+            "numeric",
+            event="train",
+            tag=tag,
+            step=rec["steps"],
+            **{
+                k: out[k]
+                for k in ("loss", "grad_norm", "update_ratio")
+                if k in out
+            },
+        )
+        return out
+    except Exception:  # pragma: no cover - observability must not break training
+        return None
+
+
+def training_stats() -> Dict[str, Dict[str, Any]]:
+    """Per-tag training streams as plain scalars (steps, last/min loss,
+    last update ratio, overflow count, plateau flag)."""
+    out = {}
+    with _LOCK:
+        items = list(_TRAINING.items())
+    for tag, rec in items:
+        losses = list(rec["losses"])
+        ratios = list(rec["update_ratios"])
+        gnorms = list(rec["grad_norms"])
+        out[tag] = {
+            "steps": rec["steps"],
+            "last_loss": losses[-1] if losses else None,
+            "min_loss": min(losses) if losses else None,
+            "last_grad_norm": gnorms[-1] if gnorms else None,
+            "last_update_ratio": ratios[-1] if ratios else None,
+            "overflows": rec["overflows"],
+            "plateau": rec["plateau"],
+        }
+    return out
+
+
+# ----------------------------------------------------------------------
+# the fused-dispatch hook (installed on telemetry as _NUMLENS_HOOK)
+# ----------------------------------------------------------------------
+def _on_dispatch(sig, leaves, roots, values, info) -> None:
+    """Called by ``fusion.force`` after a fused program's root values land
+    (cache-stamped, concrete). Pays one counter increment per dispatch;
+    stats/shadow work only on sampled dispatches. Never raises, never
+    forces (the values are already concrete), skips under an active jax
+    trace, and guards against re-entrancy (the canary dispatches its own
+    jitted program)."""
+    global _SEEN, _SAMPLED, _IN_HOOK
+    if not _MODE or _IN_HOOK or info is None:
+        return
+    _SEEN += 1
+    every = 1 if _MODE >= 2 else max(1, _SAMPLE_EVERY)
+    if (_SEEN - 1) % every:
+        return
+    _IN_HOOK = True
+    try:
+        import jax
+
+        for v in values:
+            if isinstance(v, jax.core.Tracer):
+                return
+        _SAMPLED += 1
+        _record_stats(info["key"], info.get("family", "?"), values, roots)
+        if _SHADOW_EVERY > 0 and _SAMPLED % _SHADOW_EVERY == 0:
+            _shadow_audit(sig, leaves, values, info)
+        if _CANARY_EVERY > 0 and _SAMPLED % _CANARY_EVERY == 0:
+            run_canary()
+    except Exception:  # pragma: no cover - the lens never breaks a dispatch
+        pass
+    finally:
+        _IN_HOOK = False
+
+
+# ----------------------------------------------------------------------
+# report block (pure module state — never forces, never initializes)
+# ----------------------------------------------------------------------
+def sampling_stats() -> Dict[str, int]:
+    return {"dispatches_seen": _SEEN, "dispatches_sampled": _SAMPLED}
+
+
+def numerics_block() -> Dict[str, Any]:
+    """The ``report()["numerics"]`` payload: mode + knobs, sampling
+    counters, tensor stats, drift ledger, canary summary, training streams
+    and findings. Pure module state — safe before any backend exists."""
+    return {
+        "mode": mode(),
+        "sample_every": _SAMPLE_EVERY,
+        "shadow_every": _SHADOW_EVERY,
+        "dispatches_seen": _SEEN,
+        "dispatches_sampled": _SAMPLED,
+        "tensor_stats": tensor_stats(),
+        "drift": drift_ledger(),
+        "canary": dict(_CANARY),
+        "training": training_stats(),
+        "findings": findings(),
+    }
+
+
+# arm from the environment at import (the hook is a set-attribute on
+# telemetry, so an unarmed import leaves the hot path untouched)
+set_mode(os.environ.get("HEAT_TPU_NUMLENS", "0"))

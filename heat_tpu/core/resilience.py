@@ -47,6 +47,12 @@ site                  where it fires
                       (``core/elastic.py``) — arming it kills-a-host
                       deterministically: the supervisor converts the fault
                       into a drain → checkpoint → mesh-reform cycle
+``numeric.sdc.<i>``   the SDC sentinel's per-device canary execution
+                      (``core/numlens.py`` ``run_canary``), device index
+                      ``<i>`` — arming it makes exactly that device return
+                      corrupt bits, so the sentinel's name-the-sick-device
+                      → ``note_device_fault`` → quarantine → mesh-shrink
+                      escalation has a deterministic true-positive test
 ====================  =====================================================
 
 :func:`inject` arms a site from a test or an experiment::
@@ -571,13 +577,17 @@ class errstate:
         _ERRSTATE = self._prev_stack.pop()
 
 
-def check_nonfinite(value, where: str = "force") -> None:
+def check_nonfinite(value, where: str = "force", *, program=None, cid=None) -> None:
     """Apply the active ``errstate`` policy to a materialized array.
 
     Call sites gate on ``resilience._ERRSTATE`` (one attribute read when the
     policy is off). Inexact dtypes only; the reduction is one jitted
     ``all(isfinite(x))`` — jit caches one tiny program per shape/sharding,
-    and the scalar read is the only sync added."""
+    and the scalar read is the only sync added. ``program``/``cid`` carry
+    the provenance of the producing fused dispatch (the program key stamped
+    on the root at force time and the chain's correlation id) so the
+    warning/raise names WHICH program manufactured the inf/NaN instead of
+    just where it was caught."""
     mode = _ERRSTATE
     if mode is None:
         return
@@ -602,11 +612,27 @@ def check_nonfinite(value, where: str = "force") -> None:
         return
     if telemetry._MODE:
         telemetry.record_nonfinite(where)
+    origin = ""
+    if program is not None or cid is not None:
+        origin = (
+            f" produced by fused program {program or '<eager>'}"
+            f" (chain cid {cid if cid is not None else '?'})"
+        )
     msg = (
         f"non-finite values (inf/NaN) detected at {where} point "
-        f"(shape {tuple(getattr(value, 'shape', ()))}, dtype {np.dtype(dtype).name}) "
-        "under ht.errstate"
+        f"(shape {tuple(getattr(value, 'shape', ()))}, dtype {np.dtype(dtype).name})"
+        f"{origin} under ht.errstate"
     )
+    try:
+        from . import numlens
+
+        if numlens.active():
+            numlens._add_finding(
+                "numlens.nonfinite", "error", msg,
+                where=where, program=program, cid=cid,
+            )
+    except Exception:  # pragma: no cover - import-order safety only
+        pass
     if mode == "raise":
         raise NonFiniteError(msg)
     warnings.warn(NonFiniteWarning(msg), stacklevel=3)

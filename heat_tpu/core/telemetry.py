@@ -259,6 +259,15 @@ _SYNC_HOOK = None
 #: downtime, steps replayed); None until the elastic module loads.
 _ELASTIC_HOOK = None
 
+#: numerics-lens sampling hook (``core/numlens.py`` installs its
+#: ``_on_dispatch`` here via ``numlens.set_mode`` — same set-attribute
+#: pattern). Called by ``fusion.force`` as ``_NUMLENS_HOOK(sig, leaves,
+#: roots, values, info)`` after a fused program's root values land, so the
+#: lens can sample streaming tensor statistics and shadow-replay drift
+#: audits; None whenever ``HEAT_TPU_NUMLENS`` is off — the disabled hot
+#: path pays exactly this one ``is None`` check.
+_NUMLENS_HOOK = None
+
 
 def active() -> bool:
     """Whether telemetry is recording (``HEAT_TPU_TELEMETRY`` knob)."""
@@ -448,7 +457,9 @@ def reset() -> None:
     ``core/memledger`` session state (watermark, gate counters, stored OOM
     report — the budget arming itself is configuration and survives) and the
     ``core/health_runtime`` session state (flight ring, latency histograms,
-    SLO windows, stall log — knobs and watchdog arming survive) with them:
+    SLO windows, stall log — knobs and watchdog arming survive) and the
+    ``core/numlens`` session state (tensor stats, drift ledger, canary and
+    training streams — the lens mode survives) with them:
     the report surfaces are joined — ``report()`` merges timers, the memory
     block and the health block in, so a reset that left any stale would
     mislabel the next bench's report. The mode is left untouched; active
@@ -480,6 +491,12 @@ def reset() -> None:
         from . import elastic
 
         elastic.reset()
+    except Exception:  # pragma: no cover - import-order safety only
+        pass
+    try:
+        from . import numlens
+
+        numlens.reset()
     except Exception:  # pragma: no cover - import-order safety only
         pass
 
@@ -1351,6 +1368,20 @@ def _memory_block() -> Dict[str, Any]:
     return out
 
 
+def _numerics_block() -> Dict[str, Any]:
+    """The numerics-observability picture (``core/numlens.py``): sampling
+    counters, per-program tensor statistics, the shadow-replay drift
+    ledger, SDC canary summary, training-signal streams and numeric
+    findings. Pure module state — never forces a chain, never initializes
+    a backend (the lens only ever sees values that already landed)."""
+    try:
+        from . import numlens
+
+        return numlens.numerics_block()
+    except Exception:  # pragma: no cover - import-order safety only
+        return {}
+
+
 def _health_block(global_view: bool = False) -> Dict[str, Any]:
     """The runtime-health picture (``core/health_runtime.py``): flight-ring
     occupancy, watchdog state + last stall diagnosis, per-program and
@@ -1435,6 +1466,7 @@ def report(*, _state: Optional[_State] = None) -> Dict[str, Any]:
         "scopes": scope_reports(),
         "memory": _memory_block(),
         "health": _health_block(global_view=_state is not None),
+        "numerics": _numerics_block(),
     }
     try:
         from . import fusion
@@ -1536,6 +1568,9 @@ _INSTANT_KINDS = {
     "stall": ("health", lambda ev: "stall:" + str(ev.get("site"))),
     "slo_breach": ("health", lambda ev: "slo:" + str(ev.get("metric"))),
     "flight_dump": ("health", lambda ev: "flight_dump:" + str(ev.get("reason"))),
+    # numeric stats events additionally render as counter tracks (see
+    # trace_events) — this entry covers the drift/sdc/train instants
+    "numeric": ("numeric", lambda ev: "numeric:" + str(ev.get("event"))),
 }
 
 
@@ -1630,6 +1665,20 @@ def trace_events(evs: Optional[List[dict]] = None, pid: Optional[int] = None) ->
             out.append({"ph": "C", "cat": "memory", "name": "live_bytes_watermark",
                         "pid": pid, "tid": tid, "ts": ts,
                         "args": {"watermark": int(ev.get("watermark", 0))}})
+        elif kind == "numeric" and ev.get("event") == "stats":
+            # numerics counter tracks alongside memledger's: one track per
+            # sampled program root (rms / absmax as stacked series, plus a
+            # saturation track for the nonfinite + exponent-edge counts)
+            label = f"numerics:{ev.get('program')}[{ev.get('root')}]"
+            out.append({"ph": "C", "cat": "numeric", "name": label,
+                        "pid": pid, "tid": tid, "ts": ts,
+                        "args": {"rms": float(ev.get("rms", 0.0)),
+                                 "absmax": float(ev.get("absmax", 0.0))}})
+            out.append({"ph": "C", "cat": "numeric", "name": label + ":saturation",
+                        "pid": pid, "tid": tid, "ts": ts,
+                        "args": {"nonfinite": int(ev.get("nonfinite", 0)),
+                                 "edge_low": int(ev.get("edge_low", 0)),
+                                 "edge_high": int(ev.get("edge_high", 0))}})
         else:
             cat, name_of = _INSTANT_KINDS.get(kind, ("event", lambda e, k=kind: str(k)))
             out.append({"ph": "i", "s": "t", "cat": cat, "name": name_of(ev),
@@ -1837,6 +1886,15 @@ def validate_trace(doc_or_path, cross_host: bool = False) -> List[str]:
             problems.append(f"event {i} ({ph}) missing ts")
         if ph in ("b", "e") and "id" not in ev:
             problems.append(f"async event {i} missing id")
+        if ph == "C":
+            # counter tracks (memory live-bytes, numerics rms/saturation)
+            # must carry numeric series values or Perfetto drops the track
+            args = ev.get("args")
+            if not isinstance(args, dict) or not args:
+                problems.append(f"counter event {i} missing args series")
+            elif any(not isinstance(v, (int, float)) or isinstance(v, bool)
+                     for v in args.values()):
+                problems.append(f"counter event {i} has non-numeric series: {args}")
         if ph == "b":
             open_async[str(ev.get("id"))] = open_async.get(str(ev.get("id")), 0) + 1
         elif ph == "e":

@@ -567,6 +567,7 @@ def _detect(hosts, per_host, straggle, params) -> List[Finding]:
         findings.extend(_detect_retrace_storm(pid, evs, params))
         findings.extend(_detect_reshard_pingpong(pid, evs))
         findings.extend(_detect_idle_gaps(pid, ana, params))
+        findings.extend(_detect_numeric(pid, evs))
     if straggle.get("straggler") is not None:
         pid = straggle["straggler"]
         findings.append(Finding(
@@ -693,6 +694,64 @@ def _detect_reshard_pingpong(pid, evs) -> List[Finding]:
                 host=pid, data={"targets": [d0, d1, d2], "cids": [c0, c1, c2]},
             ))
             break  # one finding per host; the trail names the first instance
+    return findings
+
+
+#: drift above this many ULPs in a ``numeric`` drift event becomes a
+#: finding — matches core/numlens.py's default HEAT_TPU_NUMLENS_MAX_ULP
+_NUMERIC_DRIFT_ULP = 16
+
+
+def _detect_numeric(pid, evs) -> List[Finding]:
+    """Numerics-lens events on the timeline (``core/numlens.py``,
+    HEAT_TPU_NUMLENS): an ``sdc`` canary mismatch is always an error — the
+    named device returned wrong bits; a shadow-replay ``drift`` event past
+    the ULP threshold is a warning. Plain ``stats`` samples never produce
+    findings (a clean instrumented workload stays finding-free)."""
+    findings: List[Finding] = []
+    sick: Dict[str, int] = {}
+    worst_drift = None
+    for e in evs:
+        if e.get("kind") != "numeric":
+            continue
+        what = e.get("event")
+        if what == "sdc":
+            dev = str(e.get("device"))
+            sick[dev] = sick.get(dev, 0) + 1
+        elif what == "drift":
+            ulp = e.get("max_ulp") or 0
+            if ulp > _NUMERIC_DRIFT_ULP and (
+                worst_drift is None or ulp > worst_drift.get("max_ulp", 0)
+            ):
+                worst_drift = dict(e)
+    for dev, n in sorted(sick.items()):
+        findings.append(Finding(
+            rule="tracelens.sdc",
+            severity="error",
+            message=f"SDC sentinel flagged device {dev} on host {pid} "
+                    f"{n} time(s): the determinism canary returned wrong "
+                    "bits — silent data corruption, not a software bug",
+            hint="quarantine the device (resilience.note_device_fault has "
+                 "already been fed; three strikes shrink the mesh) and "
+                 "re-run the canary after a swap",
+            host=pid,
+            data={"device": dev, "hits": n},
+        ))
+    if worst_drift is not None:
+        findings.append(Finding(
+            rule="tracelens.numeric_drift",
+            severity="warning",
+            message=f"fused program {worst_drift.get('program')} drifted "
+                    f"{worst_drift.get('max_ulp')} ULP from its bitwise "
+                    f"eager replay on host {pid} — the fused reorder left "
+                    "float tolerance",
+            hint="inspect the op family ({}); consider HEAT_TPU_FUSION=0 "
+                 "for this chain or widen the accumulation dtype".format(
+                     worst_drift.get("family")),
+            host=pid,
+            data={"program": worst_drift.get("program"),
+                  "max_ulp": worst_drift.get("max_ulp")},
+        ))
     return findings
 
 

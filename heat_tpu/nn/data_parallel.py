@@ -166,6 +166,9 @@ class DataParallel:
         # (jitted with_sharding_constraint fallback)
         xb = _ensure_split(xj, 0, self.comm)
         yb = _ensure_split(yj, 0, self.comm)
+        from ..core import numlens
+
+        prev = self.params if numlens.active() else None
         if self._stateful:
             self.params, self.state, self.opt_state, loss = self._train_step(
                 self.params, self.state, self.opt_state, xb, yb
@@ -173,6 +176,13 @@ class DataParallel:
         else:
             self.params, self.opt_state, loss = self._train_step(
                 self.params, self.opt_state, xb, yb
+            )
+        if prev is not None:
+            # numerics lens (HEAT_TPU_NUMLENS): per-step loss / update-ratio
+            # streams + plateau/overflow detection over the synced gradients
+            numlens.note_training(
+                "data_parallel.step", loss=loss,
+                params=self.params, prev_params=prev,
             )
         return float(loss)
 

@@ -26,6 +26,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core import numlens
 from ..core.communication import MeshCommunication, sanitize_comm
 from .utils import DetectMetricPlateau
 
@@ -354,7 +355,16 @@ class DASO:
         gs = self._effective_global_skip()
         if gs == 0 or self.current_batch % (gs + 1) == 0:
             waits = float(min(self.batches_to_wait, gs))
+            pre_merge = self.params if numlens.active() else None
             self.params = self._global_merge(self.params, jnp.float32(waits))
+            if pre_merge is not None:
+                # numerics lens (HEAT_TPU_NUMLENS): per-merge update-ratio /
+                # loss streams + plateau/overflow detection — one module-attr
+                # read when disarmed
+                numlens.note_training(
+                    "daso.merge", loss=jnp.mean(loss),
+                    params=self.params, prev_params=pre_merge,
+                )
         # solo batches return per-device losses (no in-program collective);
         # average on the host for a uniform scalar contract
         return float(jnp.mean(loss))

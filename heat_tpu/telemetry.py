@@ -14,6 +14,8 @@ exported Chrome/Perfetto trace files without writing any analysis code:
     $ python -m heat_tpu.telemetry memory report.json --json
     $ python -m heat_tpu.telemetry health                 # flight/watchdog/SLO
     $ python -m heat_tpu.telemetry health flight_dump.json
+    $ python -m heat_tpu.telemetry numerics               # stats/drift/SDC lens
+    $ python -m heat_tpu.telemetry numerics report.json --json
 
 The implementation (and all state) lives in :mod:`heat_tpu.core.telemetry`;
 this module is a thin proxy (``heat_tpu.telemetry.report`` etc. delegate
@@ -383,6 +385,93 @@ def _diff(a: Dict[str, Any], b: Dict[str, Any], out, top: int = 40) -> int:
 # ----------------------------------------------------------------------
 # entry point
 # ----------------------------------------------------------------------
+# ----------------------------------------------------------------------
+# numerics: tensor stats + drift ledger + SDC canary + training streams
+# ----------------------------------------------------------------------
+def _numerics_doc(report_path: Optional[str]) -> Dict[str, Any]:
+    """The numerics picture to render: a saved report's (or flight-dump
+    bundle's) ``numerics`` block when a path is given, else THIS process's
+    live block — pure module state, no mesh bring-up (the same
+    never-initialize contract as ``health``)."""
+    if report_path is not None:
+        doc = _load(report_path)
+        return {"source": report_path, "numerics": doc.get("numerics") or {}}
+    from heat_tpu.core import numlens
+
+    return {"source": "<live>", "numerics": numlens.numerics_block()}
+
+
+def _show_numerics(doc: Dict[str, Any], out) -> None:
+    blk = doc.get("numerics") or {}
+    print(f"numerics ({doc.get('source', '?')}):", file=out)
+    print(
+        f"  lens: {blk.get('mode', 'off')}, sampled "
+        f"{blk.get('dispatches_sampled', 0)}/{blk.get('dispatches_seen', 0)} "
+        f"dispatches (every {blk.get('sample_every', '?')})",
+        file=out,
+    )
+    stats = blk.get("tensor_stats") or {}
+    if stats:
+        print("  tensor stats:", file=out)
+        rows = sorted(stats.items(), key=lambda kv: -kv[1].get("samples", 0))
+        for key, rec in rows[:8]:
+            for i, rr in sorted((rec.get("roots") or {}).items()):
+                flags = []
+                if rr.get("nonfinite"):
+                    flags.append(f"NONFINITE x{rr['nonfinite']}")
+                if rr.get("subnormal"):
+                    flags.append(f"subnormal {rr.get('subnormal_pct', 0)}%")
+                if rr.get("edge_high"):
+                    flags.append(f"edge_high {rr['edge_high']}")
+                print(
+                    f"    {key}[{i}] {rr.get('dtype')}  rms {rr.get('rms', 0):.4g}  "
+                    f"absmax {rr.get('absmax', 0):.4g}  x{rr.get('samples', 0)}"
+                    + ("  " + " ".join(flags) if flags else ""),
+                    file=out,
+                )
+    drift = blk.get("drift") or {}
+    progs = drift.get("programs") or {}
+    if progs:
+        print(
+            f"  drift ledger (max {drift.get('max_ulp', 0)} ULP, worst family "
+            f"{drift.get('worst_family')}):",
+            file=out,
+        )
+        for key, rec in sorted(progs.items(), key=lambda kv: -kv[1].get("max_ulp", 0))[:8]:
+            print(
+                f"    {key}  p50 {rec.get('p50_ulp', 0)} ULP  max "
+                f"{rec.get('max_ulp', 0)} ULP  x{rec.get('samples', 0)}",
+                file=out,
+            )
+    canary = blk.get("canary") or {}
+    if canary.get("runs"):
+        sick = canary.get("last_sick") or []
+        print(
+            f"  sdc canary: {canary['runs']} run(s) over "
+            f"{canary.get('devices', '?')} device(s), "
+            f"{canary.get('mismatches', 0)} mismatch(es), last "
+            f"{canary.get('last_ms', '?')}ms"
+            + (f"  SICK: {', '.join(sick)}" if sick else ""),
+            file=out,
+        )
+    for tag, rec in (blk.get("training") or {}).items():
+        extras = []
+        if rec.get("overflows"):
+            extras.append(f"OVERFLOWS x{rec['overflows']}")
+        if rec.get("plateau"):
+            extras.append("PLATEAU")
+        ratio = rec.get("last_update_ratio")
+        print(
+            f"  train[{tag}]: {rec.get('steps', 0)} step(s), loss "
+            f"{rec.get('last_loss')}"
+            + (f", update_ratio {ratio:.3g}" if ratio is not None else "")
+            + ("  " + " ".join(extras) if extras else ""),
+            file=out,
+        )
+    for f in (blk.get("findings") or [])[-5:]:
+        print(f"  {f.get('severity', '?').upper()}: {f.get('message')}", file=out)
+
+
 def main(argv: Optional[List[str]] = None, out=None) -> int:
     out = out if out is not None else sys.stdout
     parser = argparse.ArgumentParser(
@@ -424,6 +513,21 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
         "process's live health block (pure module state, no mesh bring-up)",
     )
     p_health.add_argument("--json", action="store_true", help="emit JSON instead of text")
+    p_num = sub.add_parser(
+        "numerics",
+        help="numerics lens: streaming tensor stats, shadow-replay drift "
+        "ledger, SDC canary summary and training-signal streams (from a "
+        "report_json artifact or a flight-dump bundle, or live from this "
+        "process)",
+    )
+    p_num.add_argument(
+        "report",
+        nargs="?",
+        default=None,
+        help="a report_json artifact or flight-dump bundle; omitted = THIS "
+        "process's live numerics block (pure module state, no mesh bring-up)",
+    )
+    p_num.add_argument("--json", action="store_true", help="emit JSON instead of text")
     p_ana = sub.add_parser(
         "analyze",
         help="tracelens diagnosis of a trace: time attribution per bucket, "
@@ -488,6 +592,13 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
             print(json.dumps(_core._jsonable(doc), indent=2, sort_keys=True), file=out)
         else:
             _show_health(doc, out)
+        return 0
+    if args.cmd == "numerics":
+        doc = _numerics_doc(args.report)
+        if args.json:
+            print(json.dumps(_core._jsonable(doc), indent=2, sort_keys=True), file=out)
+        else:
+            _show_numerics(doc, out)
         return 0
     if args.cmd == "analyze":
         from heat_tpu.core import tracelens

@@ -93,7 +93,29 @@ HEAT_TPU_FAULTS=ci HEAT_TPU_TELEMETRY=1 \
   python -m pytest tests/test_resilience.py tests/test_resilience_io.py tests/test_io_errors.py \
     tests/test_checkpoint_resilience.py tests/test_checkpoint_profiling.py \
     tests/test_fused_collectives.py tests/test_trace_timeline.py \
-    tests/test_memory_obs.py tests/test_tracelens.py -q -x
+    tests/test_memory_obs.py tests/test_tracelens.py tests/test_numlens.py -q -x
+# SDC-injection smoke: arm the numeric.sdc fault site on one device and prove
+# the sentinel NAMES it — the true-positive path of the canary, end to end
+# through the quarantine ledger (tests pin the MeshDegradedWarning escalation)
+echo "=== SDC sentinel smoke (HEAT_TPU_FAULTS='numeric.sdc.0:every=1') ==="
+HEAT_TPU_FAULTS='numeric.sdc.0:every=1' HEAT_TPU_NUMLENS=full python - <<'PY'
+import numpy as np, heat_tpu as ht
+from heat_tpu.core import numlens
+float(ht.sum(ht.array(np.ones(8, np.float32), split=0)))  # bring the mesh up
+r = numlens.run_canary()
+assert r is not None and r["mismatches"], f"sentinel missed the sick device: {r}"
+sdc = [f for f in numlens.findings() if f["rule"] == "numlens.sdc"]
+assert sdc, "no numlens.sdc finding emitted"
+print("SDC sentinel OK:", sdc[0]["device"])
+PY
+# numerics-lens leg: the numerics observability layer ARMED in sampling mode
+# (every fused dispatch pays the hook check, sampled dispatches pay the jitted
+# stats kernel + periodic shadow replay) while the numlens suite and the
+# eager-chain suite run — the lens must change no results; the suite's own
+# overhead/never-forces/never-initializes pins run armed too
+echo "=== numerics lens (HEAT_TPU_NUMLENS=sample) ==="
+HEAT_TPU_NUMLENS=sample HEAT_TPU_TELEMETRY=1 \
+  python -m pytest tests/test_numlens.py tests/test_eager_chain.py -q -x
 # runtime-health leg (core/health_runtime.py): flight recorder ARMED with a
 # small ring and the stall watchdog live under the warn policy (every fused
 # dispatch and blocking sync pays the guard arm/disarm and the ring append)

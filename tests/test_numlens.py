@@ -1,0 +1,699 @@
+"""Numerics observability (ISSUE 14): streaming tensor statistics,
+shadow-replay drift audit, SDC sentinel, training-signal telemetry.
+
+Pins the acceptance criteria: sampled fused dispatches gain streaming
+stats (rms/absmax/nonfinite/subnormal/exponent histogram) aggregated into
+``report()["numerics"]`` and exported as Perfetto counter tracks that
+round-trip ``validate_trace``; the shadow-replay drift ledger reports
+0 ULP on a bitwise-identical elementwise chain and nonzero on a
+reorder-sensitive reduction; an injected ``numeric.sdc`` fault on one
+device makes the canary name that device and escalate through
+``note_device_fault`` into quarantine/mesh-shrink (true positive) while a
+healthy mesh stays silent (true negative); ``ht.errstate`` nonfinite
+findings carry program/cid provenance; and none of it ever forces a
+pending chain or initializes the backend. Runs green at mesh 1/3/8,
+fusion-off, and under ``HEAT_TPU_FAULTS=ci`` (setUp suspends the ambient
+mix; every test restores the knobs it touches).
+"""
+
+import importlib
+import io
+import json
+import math
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import unittest
+import warnings
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu.core import (
+    communication,
+    fusion,
+    health_runtime,
+    numlens,
+    resilience,
+    telemetry,
+    tracelens,
+)
+
+from harness import TestCase
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class NumlensCase(TestCase):
+    """Clean lens state per test: ambient faults suspended (exact-count
+    pins stay exact under HEAT_TPU_FAULTS=ci), program cache cleared,
+    every knob this suite touches saved and restored."""
+
+    def setUp(self):
+        self._suspend = resilience.suspended()
+        self._suspend.__enter__()
+        fusion.clear_cache()
+        telemetry.reset()  # cascades into numlens.reset()
+        resilience.reset_device_faults()
+        self._prev_lens = numlens.set_mode("full")
+        self._prev_tmode = telemetry.set_mode(1)
+        self._prev_sample = numlens._SAMPLE_EVERY
+        self._prev_shadow = numlens._SHADOW_EVERY
+        self._prev_canary = numlens._CANARY_EVERY
+        self._prev_maxulp = numlens._MAX_ULP
+        numlens._SHADOW_EVERY = 0  # stats only unless a test opts in
+
+    def tearDown(self):
+        numlens._SAMPLE_EVERY = self._prev_sample
+        numlens._SHADOW_EVERY = self._prev_shadow
+        numlens._CANARY_EVERY = self._prev_canary
+        numlens._MAX_ULP = self._prev_maxulp
+        numlens.set_mode(self._prev_lens)
+        telemetry.set_mode(self._prev_tmode)
+        telemetry.reset()
+        resilience.reset_device_faults()
+        self._suspend.__exit__(None, None, None)
+
+    def _split_input(self, seed=0, n_mult=4, cols=3):
+        n = n_mult * self.get_size()
+        return ht.array(
+            np.random.default_rng(seed).standard_normal((n, cols)).astype(np.float32),
+            split=0,
+        )
+
+    def _one_record(self):
+        stats = numlens.tensor_stats()
+        self.assertEqual(len(stats), 1, stats)
+        (key, rec), = stats.items()
+        self.assertEqual(len(rec["roots"]), 1, rec)
+        return key, rec, rec["roots"][0]
+
+
+# ----------------------------------------------------------------------
+# pillar 1: streaming tensor statistics
+# ----------------------------------------------------------------------
+@unittest.skipUnless(fusion.active(), "the lens samples at the fused-dispatch seam")
+class TestTensorStats(NumlensCase):
+    def test_stats_match_numpy_on_a_forced_chain(self):
+        n = 8 * self.get_size()
+        data = np.random.default_rng(3).standard_normal((n, 4)).astype(np.float32)
+        a = ht.array(data, split=0)
+        got = np.asarray((a * 2.0 + 1.0).larray)
+        key, rec, rr = self._one_record()
+        expected = data * 2.0 + 1.0
+        self.assertEqual(rr["dtype"], "float32")
+        self.assertEqual(rr["nonfinite"], 0)
+        self.assertAlmostEqual(
+            rr["rms"], float(np.sqrt(np.mean(np.square(expected)))), places=4
+        )
+        self.assertAlmostEqual(rr["absmax"], float(np.abs(expected).max()), places=4)
+        self.assertEqual(sum(rr["hist"]), int(np.count_nonzero(expected)))
+        np.testing.assert_array_equal(got, expected)
+
+    def test_nonfinite_and_subnormal_counts_are_exact(self):
+        n = 8 * self.get_size()
+        data = np.ones((n, 4), np.float32)
+        data[0, 0] = np.inf
+        data[0, 1] = np.nan
+        data[1, :2] = 1e-41  # subnormal in float32 (tiny ~ 1.18e-38)
+        a = ht.array(data, split=0)
+        # sign-manipulation chain: XLA CPU's fused arithmetic pipelines may
+        # flush subnormal operands to zero (FTZ), but abs is a bit op and
+        # the lens reads bit patterns, so the count stays exact
+        np.asarray(ht.abs(a).larray)
+        _, _, rr = self._one_record()
+        self.assertEqual(rr["nonfinite"], 2)
+        self.assertEqual(rr["subnormal"], 2)
+        self.assertGreater(rr["subnormal_pct"], 0.0)
+        # subnormals land in the lowest exponent bucket (edge saturation)
+        self.assertGreaterEqual(rr["edge_low"], 2)
+
+    def test_aggregation_accumulates_across_samples(self):
+        a = self._split_input()
+        for _ in range(3):
+            np.asarray((a * 1.0).larray)
+            fusion.clear_cache()  # re-dispatch the same program key
+        stats = numlens.tensor_stats()
+        rec = next(iter(stats.values()))
+        self.assertEqual(rec["samples"], 3)
+        self.assertEqual(rec["roots"][0]["samples"], 3)
+
+    def test_sample_throttle_in_sample_mode(self):
+        numlens.set_mode("sample")
+        numlens._SAMPLE_EVERY = 8
+        a = self._split_input()
+        for _ in range(16):
+            float(ht.sum(ht.exp(a * 0.1)))
+        blk = numlens.numerics_block()
+        self.assertEqual(blk["dispatches_seen"], 16)
+        self.assertEqual(blk["dispatches_sampled"], 2)
+
+    def test_disabled_lens_is_a_no_op(self):
+        numlens.set_mode(0)
+        self.assertIsNone(telemetry._NUMLENS_HOOK)
+        a = self._split_input()
+        float(ht.sum(a * 2.0))
+        blk = numlens.numerics_block()
+        self.assertEqual(blk["mode"], "off")
+        self.assertEqual(blk["dispatches_seen"], 0)
+        self.assertEqual(blk["tensor_stats"], {})
+
+
+@unittest.skipUnless(fusion.active(), "the lens samples at the fused-dispatch seam")
+class TestHalfWidthEdgeStats(NumlensCase):
+    """bf16/f16 edge statistics on the collective dtypes matrix (EQuARX
+    per-block-scale prework): subnormal fraction and exponent-histogram
+    saturation at the dynamic-range edges, at mesh sizes 1/3/8."""
+
+    MESH_SIZES = (1, 3, 8)
+
+    def _edge_cases(self):
+        # (heat dtype, big values saturating the top buckets, tiny subnormals)
+        yield ht.bfloat16, 3.0e38, 5.0e-40  # bf16 max ~3.39e38, tiny ~1.18e-38
+        yield ht.float16, 6.0e4, 3.0e-6  # f16 max 65504, tiny ~6.1e-5
+
+    def test_edge_saturation_every_mesh_size(self):
+        devs = jax.devices()
+        for k in self.MESH_SIZES:
+            if k > len(devs):
+                continue
+            comm = communication.MeshCommunication(devs[:k])
+            for dt, big, tiny in self._edge_cases():
+                telemetry.reset()
+                fusion.clear_cache()
+                n = 8 * k
+                data = np.ones((n, 4), np.float32)
+                data[:, 1] = big
+                data[:, 2] = tiny
+                a = ht.array(data, split=0, dtype=dt, comm=comm)
+                # a same-dtype chain: abs() keeps the half-width dtype so
+                # the lens samples the bf16/f16 tensor itself
+                forced = np.asarray(ht.abs(a).larray, dtype=np.float32)
+                stats = numlens.tensor_stats()
+                self.assertTrue(stats, f"no stats at mesh {k} dtype {dt}")
+                rr = next(iter(stats.values()))["roots"][0]
+                self.assertEqual(rr["dtype"], str(np.dtype(dt._jax_dtype)))
+                self.assertGreater(
+                    rr["edge_high"], 0,
+                    f"{dt} big values missed the top exponent bucket at mesh {k}",
+                )
+                self.assertGreater(
+                    rr["subnormal"], 0,
+                    f"{dt} subnormals uncounted at mesh {k}",
+                )
+                self.assertGreaterEqual(rr["edge_low"], rr["subnormal"])
+                self.assertEqual(rr["nonfinite"], 0)
+                self.assertTrue(np.all(forced >= 0))
+
+
+# ----------------------------------------------------------------------
+# pillar 2: shadow-replay drift audit
+# ----------------------------------------------------------------------
+class TestUlpDiff(NumlensCase):
+    def test_identical_bits_are_zero(self):
+        x = np.random.default_rng(0).standard_normal(64).astype(np.float32)
+        self.assertEqual(int(numlens.ulp_diff(x, x.copy()).max()), 0)
+
+    def test_adjacent_floats_are_one_ulp(self):
+        x = np.asarray([1.0, -2.5, 3e-30], np.float32)
+        y = np.nextafter(x, np.inf)
+        np.testing.assert_array_equal(numlens.ulp_diff(x, y), [1, 1, 1])
+
+    def test_signed_zero_coincides(self):
+        self.assertEqual(
+            int(numlens.ulp_diff(np.float32(0.0), np.float32(-0.0))[0]), 0
+        )
+
+    def test_scalar_zero_d_inputs_work(self):
+        # 0-d arrays reject dtype-changing views; ulp_diff must atleast_1d
+        self.assertEqual(int(numlens.ulp_diff(np.float64(1.0), np.float64(1.0))[0]), 0)
+
+    def test_nonfinite_pairs(self):
+        nan, one = np.float32(np.nan), np.float32(1.0)
+        self.assertEqual(int(numlens.ulp_diff(nan, nan)[0]), 0)  # both nonfinite
+        self.assertEqual(int(numlens.ulp_diff(nan, one)[0]), numlens._ULP_SENTINEL)
+
+    def test_half_width_dtypes(self):
+        x = jnp.asarray([1.0, 2.0], jnp.bfloat16)
+        y = jnp.asarray([1.0, 2.0], jnp.bfloat16)
+        self.assertEqual(int(numlens.ulp_diff(np.asarray(x), np.asarray(y)).max()), 0)
+
+    def test_rejects_unsupported_dtypes(self):
+        with self.assertRaises(TypeError):
+            numlens.ulp_diff(np.arange(3), np.arange(3))
+
+
+@unittest.skipUnless(fusion.active(), "shadow replay re-executes the fused program")
+class TestDriftAudit(NumlensCase):
+    def setUp(self):
+        super().setUp()
+        numlens._SHADOW_EVERY = 1  # audit every sampled dispatch
+
+    def test_bitwise_identical_elementwise_chain_is_zero_ulp(self):
+        a = self._split_input(seed=1)
+        b = self._split_input(seed=2)
+        np.asarray((ht.exp(a * 0.5) + b * 2.0 - 1.0).larray)
+        led = numlens.drift_ledger()
+        self.assertTrue(led["programs"], "no drift samples recorded")
+        self.assertEqual(led["max_ulp"], 0, led)
+
+    def test_reorder_sensitive_reduction_drifts_nonzero(self):
+        # jit reassociates big reductions (vectorized tiling) where the
+        # eager bitwise replay accumulates in op order — at least one of
+        # these chains drifts at every mesh size 1/3/5/8 (probed; which one
+        # depends on XLA's per-shard tiling choices)
+        rng = np.random.default_rng(7)
+        big = ht.array(rng.standard_normal((4096, 32)).astype(np.float32), split=0)
+        big.larray  # force the leaf: the audited programs start concrete
+        telemetry.reset()
+        float(ht.sum((big / 3.0).sum(axis=1)))
+        float(ht.std(big * big + 1.0))
+        float(ht.mean(ht.exp(big * 0.1) * big))
+        led = numlens.drift_ledger()
+        self.assertGreaterEqual(len(led["programs"]), 3, led)
+        self.assertGreater(led["max_ulp"], 0, led)
+        self.assertIsNotNone(led["worst_program"])
+        self.assertIn("sum", str(led["worst_family"]) + str(
+            [v["family"] for v in led["programs"].values()]
+        ))
+
+    def test_drift_past_threshold_raises_a_finding(self):
+        numlens._MAX_ULP = 0  # any nonzero drift becomes a finding
+        rng = np.random.default_rng(7)
+        big = ht.array(rng.standard_normal((4096, 32)).astype(np.float32), split=0)
+        big.larray
+        telemetry.reset()
+        float(ht.sum((big / 3.0).sum(axis=1)))
+        float(ht.std(big * big + 1.0))
+        float(ht.mean(ht.exp(big * 0.1) * big))
+        hits = [f for f in numlens.findings() if f["rule"] == "numlens.drift"]
+        self.assertTrue(hits, numlens.findings())
+        self.assertEqual(hits[0]["severity"], "warning")
+        self.assertIn("ULP", hits[0]["message"])
+
+    def test_shadow_throttle(self):
+        numlens._SHADOW_EVERY = 4
+        a = self._split_input()
+        for _ in range(8):
+            float(ht.sum(ht.exp(a * 0.1)))
+        led = numlens.drift_ledger()
+        samples = sum(v["samples"] for v in led["programs"].values())
+        self.assertEqual(samples, 2)  # 8 sampled dispatches / every 4
+
+
+# ----------------------------------------------------------------------
+# pillar 3: SDC sentinel
+# ----------------------------------------------------------------------
+class TestSDCSentinel(NumlensCase):
+    def setUp(self):
+        super().setUp()
+        # the canary only probes an already-initialized mesh (never-initializes
+        # pin); bring the world up explicitly since under HEAT_TPU_FUSION=0 no
+        # earlier test in this file has done so
+        communication.get_comm()
+
+    def test_healthy_mesh_stays_silent(self):
+        r = numlens.run_canary()
+        self.assertIsNotNone(r)
+        self.assertEqual(r["mismatches"], [])
+        self.assertEqual(
+            [f for f in numlens.findings() if f["rule"] == "numlens.sdc"], []
+        )
+        self.assertEqual(list(resilience.degraded_devices()), [])
+        self.assertGreater(r["ms"], 0.0)
+
+    def test_injected_sdc_names_the_device_and_escalates(self):
+        idx = self.get_size() - 1
+        dev = str(self.comm.devices[idx])
+        with resilience.inject(f"numeric.sdc.{idx}", times=3):
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                for _ in range(3):
+                    r = numlens.run_canary()
+                    self.assertEqual(r["mismatches"], [dev])
+        # the finding names the sick device
+        hits = [f for f in numlens.findings() if f["rule"] == "numlens.sdc"]
+        self.assertEqual(len(hits), 3)
+        self.assertEqual(hits[0]["device"], dev)
+        self.assertEqual(hits[0]["index"], idx)
+        self.assertIn(dev, hits[0]["message"])
+        # three strikes: quarantined + MeshDegradedWarning (the elastic
+        # supervisor consumes degraded_devices() for the mesh shrink)
+        self.assertIn(dev, [str(d) for d in resilience.degraded_devices()])
+        degraded = [
+            w for w in caught
+            if issubclass(w.category, resilience.MeshDegradedWarning)
+        ]
+        self.assertEqual(len(degraded), 1, [str(w.message) for w in caught])
+        self.assertIn(dev, str(degraded[0].message))
+        # only the sick device was flagged — the healthy ones stayed clean
+        healthy = {str(d) for d in self.comm.devices} - {dev}
+        flagged = {f["device"] for f in hits}
+        self.assertEqual(flagged & healthy, set())
+
+    def test_canary_summary_in_the_block(self):
+        numlens.run_canary()
+        blk = numlens.numerics_block()
+        self.assertEqual(blk["canary"]["runs"], 1)
+        self.assertEqual(blk["canary"]["devices"], self.get_size())
+        self.assertEqual(blk["canary"]["mismatches"], 0)
+
+    @unittest.skipUnless(fusion.active(), "periodic canaries ride the sampled dispatch")
+    def test_periodic_canary_fires_from_the_hook(self):
+        numlens._CANARY_EVERY = 2
+        a = self._split_input()
+        for _ in range(4):
+            float(ht.sum(ht.exp(a * 0.1)))
+        self.assertEqual(numlens.numerics_block()["canary"].get("runs", 0), 2)
+
+
+# ----------------------------------------------------------------------
+# pillar 4: training-signal telemetry
+# ----------------------------------------------------------------------
+class TestTrainingSignals(NumlensCase):
+    def _params(self, scale=1.0):
+        return {
+            "w": jnp.asarray(np.full((4, 4), scale, np.float32)),
+            "b": jnp.asarray(np.full((4,), scale, np.float32)),
+        }
+
+    def test_update_ratio_and_streams(self):
+        out = numlens.note_training(
+            "unit", loss=2.5, params=self._params(1.1), prev_params=self._params(1.0)
+        )
+        self.assertEqual(out["step"], 1)
+        self.assertAlmostEqual(out["loss"], 2.5)
+        # |delta| = 0.1 * sqrt(20), |p| = 1.1 * sqrt(20)
+        self.assertAlmostEqual(out["update_ratio"], 0.1 / 1.1, places=5)
+        st = numlens.training_stats()["unit"]
+        self.assertEqual(st["steps"], 1)
+        self.assertAlmostEqual(st["last_loss"], 2.5)
+
+    def test_grad_norm_stream(self):
+        out = numlens.note_training("unit", grads=self._params(2.0))
+        self.assertAlmostEqual(out["grad_norm"], 2.0 * math.sqrt(20.0), places=4)
+
+    def test_overflow_detector(self):
+        numlens.note_training("boom", loss=float("nan"))
+        hits = [f for f in numlens.findings() if f["rule"] == "numlens.overflow"]
+        self.assertEqual(len(hits), 1)
+        self.assertEqual(hits[0]["severity"], "error")
+        self.assertEqual(numlens.training_stats()["boom"]["overflows"], 1)
+
+    def test_plateau_detector_flags_once_and_rearms(self):
+        for _ in range(numlens._PLATEAU_WINDOW):
+            numlens.note_training("flat", loss=1.0)
+        self.assertTrue(numlens.training_stats()["flat"]["plateau"])
+        hits = [f for f in numlens.findings() if f["rule"] == "numlens.plateau"]
+        self.assertEqual(len(hits), 1)
+        # stays flagged-once while flat
+        numlens.note_training("flat", loss=1.0)
+        hits = [f for f in numlens.findings() if f["rule"] == "numlens.plateau"]
+        self.assertEqual(len(hits), 1)
+        # a moving loss rearms the detector
+        for i in range(numlens._PLATEAU_WINDOW):
+            numlens.note_training("flat", loss=1.0 + 0.1 * i)
+        self.assertFalse(numlens.training_stats()["flat"]["plateau"])
+
+    def test_noisy_loss_is_not_a_plateau(self):
+        for i in range(2 * numlens._PLATEAU_WINDOW):
+            numlens.note_training("noisy", loss=1.0 + 0.01 * ((-1) ** i))
+        self.assertFalse(numlens.training_stats()["noisy"]["plateau"])
+        self.assertEqual(
+            [f for f in numlens.findings() if f["rule"] == "numlens.plateau"], []
+        )
+
+    def test_disabled_lens_records_nothing(self):
+        numlens.set_mode(0)
+        self.assertIsNone(numlens.note_training("off", loss=1.0))
+        self.assertEqual(numlens.training_stats(), {})
+
+    def test_data_parallel_step_feeds_the_stream(self):
+        import optax
+
+        dp = ht.nn.DataParallel(
+            ht.nn.MLP(features=(8, 2)), comm=self.comm, optimizer=optax.sgd(0.05)
+        )
+        rng = np.random.default_rng(0)
+        n = 4 * self.get_size()
+        x = rng.standard_normal((n, 6)).astype(np.float32)
+        y = rng.integers(0, 2, n).astype(np.int32)
+        dp.init(0, x[:2])
+        for _ in range(3):
+            dp.train_step(x, y)
+        st = numlens.training_stats().get("data_parallel.step")
+        self.assertIsNotNone(st, numlens.training_stats())
+        self.assertEqual(st["steps"], 3)
+        self.assertIsNotNone(st["last_loss"])
+        self.assertTrue(math.isfinite(st["last_loss"]))
+        self.assertIsNotNone(st["last_update_ratio"])
+        self.assertGreater(st["last_update_ratio"], 0.0)
+
+
+# ----------------------------------------------------------------------
+# seams: report / events / export / CLI / flight / errstate provenance
+# ----------------------------------------------------------------------
+class TestSeams(NumlensCase):
+    def test_report_carries_the_numerics_block(self):
+        blk = telemetry.report()["numerics"]
+        for key in ("mode", "tensor_stats", "drift", "canary", "training", "findings"):
+            self.assertIn(key, blk)
+        self.assertEqual(blk["mode"], "full")
+        # and it round-trips the deterministic JSON projection
+        doc = json.loads(telemetry.report_json())
+        self.assertIn("numerics", doc)
+
+    @unittest.skipUnless(fusion.active(), "the lens samples at the fused-dispatch seam")
+    def test_reset_clears_the_session_but_keeps_the_mode(self):
+        a = self._split_input()
+        float(ht.sum(a * 2.0))
+        self.assertGreater(numlens.numerics_block()["dispatches_seen"], 0)
+        telemetry.reset()
+        blk = numlens.numerics_block()
+        self.assertEqual(blk["dispatches_seen"], 0)
+        self.assertEqual(blk["tensor_stats"], {})
+        self.assertEqual(blk["mode"], "full")  # arming survives
+
+    @unittest.skipUnless(fusion.active(), "numeric events ride the fused dispatch")
+    def test_numeric_events_export_as_counter_tracks_and_validate(self):
+        prev = telemetry.set_mode(2)
+        try:
+            telemetry.reset()
+            a = self._split_input()
+            float(ht.sum(ht.exp(a * 0.25)))
+            evs = telemetry.events()
+            numeric = [e for e in evs if e.get("kind") == "numeric"]
+            self.assertTrue(numeric, [e.get("kind") for e in evs])
+            self.assertEqual(numeric[0]["event"], "stats")
+            doc = telemetry.export_trace()
+            counters = [
+                e for e in doc["traceEvents"]
+                if e.get("ph") == "C" and e.get("cat") == "numeric"
+            ]
+            self.assertTrue(counters)
+            names = {e["name"] for e in counters}
+            self.assertTrue(any(n.endswith(":saturation") for n in names), names)
+            self.assertEqual(telemetry.validate_trace(doc), [])
+            with tempfile.TemporaryDirectory() as td:
+                paths = []
+                for host in range(2):
+                    p = os.path.join(td, f"trace_{host}.json")
+                    with open(p, "w") as f:
+                        json.dump(doc, f)
+                    paths.append(p)
+                merged = telemetry.merge_traces(paths)
+            self.assertEqual(telemetry.validate_trace(merged), [])
+        finally:
+            telemetry.set_mode(prev)
+
+    def test_validator_rejects_a_broken_counter_track(self):
+        doc = {"traceEvents": [
+            {"ph": "C", "pid": 0, "tid": 0, "ts": 1.0, "cat": "numeric",
+             "name": "numerics:x[0]", "args": {"rms": "not-a-number"}},
+        ]}
+        problems = telemetry.validate_trace(doc)
+        self.assertTrue(any("non-numeric" in p for p in problems), problems)
+
+    @unittest.skipUnless(fusion.active(), "provenance is stamped at the fused dispatch")
+    def test_errstate_nonfinite_names_the_producing_program(self):
+        n = 4 * self.get_size()
+        x = ht.array(np.full((n, 2), -1.0, np.float32), split=0)
+        y = ht.log(x) + 1.0  # nan, deferred
+        self.assertTrue(fusion.is_deferred(y))
+        with ht.errstate(nonfinite="warn"):
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                np.asarray(y.larray)
+        hits = [w for w in caught if issubclass(w.category, resilience.NonFiniteWarning)]
+        self.assertEqual(len(hits), 1, [str(w.message) for w in caught])
+        msg = str(hits[0].message)
+        self.assertIn("produced by fused program", msg)
+        self.assertIn("cid", msg)
+        # the program key in the message is a real cached program
+        self.assertTrue(any(k in msg for k in self._program_keys()), msg)
+        # and the lens kept it as a finding with the same provenance
+        fnd = [f for f in numlens.findings() if f["rule"] == "numlens.nonfinite"]
+        self.assertEqual(len(fnd), 1)
+        self.assertIsNotNone(fnd[0]["program"])
+        self.assertIsNotNone(fnd[0]["cid"])
+
+    def _program_keys(self):
+        from heat_tpu.core.fusion import _PROGRAM_INFO
+
+        return [info["key"] for info in _PROGRAM_INFO.values()] or [""]
+
+    @unittest.skipUnless(fusion.active(), "flight events ride the fused dispatch seam")
+    def test_flight_bundle_embeds_numeric_findings(self):
+        prev_flight = health_runtime.set_flight(True, 256)
+        tmp = tempfile.mkdtemp(prefix="heat_tpu_numlens_test_")
+        prev_dir = health_runtime.set_dump_dir(tmp)
+        try:
+            numlens._add_finding("numlens.sdc", "error", "synthetic", device="d0")
+            a = self._split_input()
+            float(ht.sum(a * 2.0))
+            dump = health_runtime.dump_flight(reason="numlens-test")
+            with open(dump["path"]) as fh:
+                bundle = json.load(fh)
+            self.assertIn("numerics", bundle)
+            self.assertIn("diagnosis", bundle)
+            rules = [f.get("rule") for f in bundle["numerics"]["findings"]]
+            self.assertIn("numlens.sdc", rules)
+            self.assertIn("drift", bundle["numerics"])
+        finally:
+            health_runtime.set_dump_dir(prev_dir)
+            health_runtime.set_flight(prev_flight[0], prev_flight[1])
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def test_tracelens_diagnose_surfaces_sdc_and_drift(self):
+        evs = [
+            {"kind": "dispatch", "ts": 0.0, "cid": 1, "cids": [1],
+             "roots": 1, "program": "p1"},
+            {"kind": "blocking_sync", "ts": 0.0, "cid": 1, "dur": 0.1,
+             "where": "item"},
+            {"kind": "numeric", "ts": 0.02, "event": "sdc",
+             "device": "TFRT_CPU_3", "index": 3, "why": "bitwise mismatch"},
+            {"kind": "numeric", "ts": 0.03, "event": "drift", "program": "p1",
+             "family": "sum", "p50_ulp": 4, "max_ulp": 4096},
+            {"kind": "numeric", "ts": 0.04, "event": "stats", "program": "p1",
+             "root": 0, "rms": 1.0, "absmax": 2.0, "nonfinite": 0},
+        ]
+        diag = tracelens.diagnose(evs)
+        rules = {f["rule"] for f in diag["findings"]}
+        self.assertIn("tracelens.sdc", rules)
+        self.assertIn("tracelens.numeric_drift", rules)
+        sdc = next(f for f in diag["findings"] if f["rule"] == "tracelens.sdc")
+        self.assertIn("TFRT_CPU_3", sdc["message"])
+        self.assertEqual(sdc["severity"], "error")
+
+    def test_tracelens_stays_silent_on_plain_stats(self):
+        evs = [
+            {"kind": "dispatch", "ts": 0.0, "cid": 1, "cids": [1],
+             "roots": 1, "program": "p1"},
+            {"kind": "blocking_sync", "ts": 0.0, "cid": 1, "dur": 0.01,
+             "where": "item"},
+            {"kind": "numeric", "ts": 0.02, "event": "stats", "program": "p1",
+             "root": 0, "rms": 1.0, "absmax": 2.0, "nonfinite": 0},
+            {"kind": "numeric", "ts": 0.03, "event": "drift", "program": "p1",
+             "family": "sum", "p50_ulp": 0, "max_ulp": 1},
+        ]
+        diag = tracelens.diagnose(evs)
+        numeric_rules = [f for f in diag["findings"]
+                         if f["rule"] in ("tracelens.sdc", "tracelens.numeric_drift")]
+        self.assertEqual(numeric_rules, [])
+
+
+class TestCLI(NumlensCase):
+    def _cli(self):
+        return importlib.import_module("heat_tpu.telemetry")
+
+    @unittest.skipUnless(fusion.active(), "the lens samples at the fused-dispatch seam")
+    def test_numerics_verb_live_and_from_file(self):
+        a = self._split_input()
+        float(ht.sum(ht.exp(a * 0.1)))
+        numlens.run_canary()
+        out = io.StringIO()
+        rc = self._cli().main(["numerics"], out=out)
+        self.assertEqual(rc, 0)
+        text = out.getvalue()
+        self.assertIn("numerics (<live>)", text)
+        self.assertIn("tensor stats", text)
+        self.assertIn("sdc canary", text)
+        # from a saved report artifact, as JSON
+        with tempfile.NamedTemporaryFile("w", suffix=".json", delete=False) as fh:
+            fh.write(telemetry.report_json())
+            path = fh.name
+        try:
+            out = io.StringIO()
+            rc = self._cli().main(["numerics", path, "--json"], out=out)
+            self.assertEqual(rc, 0)
+            doc = json.loads(out.getvalue())
+            self.assertEqual(doc["source"], path)
+            self.assertTrue(doc["numerics"]["tensor_stats"])
+        finally:
+            os.unlink(path)
+
+
+# ----------------------------------------------------------------------
+# purity contracts: never forces, never initializes
+# ----------------------------------------------------------------------
+class TestContracts(NumlensCase):
+    @unittest.skipUnless(fusion.active(), "fusion disabled via HEAT_TPU_FUSION")
+    def test_block_reads_never_force_a_pending_chain(self):
+        a = self._split_input()
+        x = ht.exp(a * 0.5) + 1.0
+        self.assertTrue(fusion.is_deferred(x))
+        numlens.numerics_block()
+        numlens.drift_ledger()
+        numlens.tensor_stats()
+        numlens.findings()
+        telemetry.report()
+        self.assertTrue(fusion.is_deferred(x), "a numerics read forced the chain")
+
+    def test_lens_never_initializes_the_backend(self):
+        # armed from the environment, the module import + every pure-state
+        # read + a canary attempt must not bring up a mesh
+        code = (
+            "from heat_tpu.core import numlens, telemetry\n"
+            "assert numlens.mode() == 'full', numlens.mode()\n"
+            "assert telemetry._NUMLENS_HOOK is not None\n"
+            "blk = numlens.numerics_block()\n"
+            "assert blk['mode'] == 'full'\n"
+            "assert numlens.run_canary() is None  # no mesh -> no canary\n"
+            "numlens.note_training('t', loss=1.0)\n"
+            "telemetry.report()\n"
+            "from heat_tpu.core import communication\n"
+            "assert communication.MESH_WORLD is None, 'backend was initialized'\n"
+            "print('OK')\n"
+        )
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["HEAT_TPU_NUMLENS"] = "full"
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, env=env, cwd=_REPO,
+        )
+        self.assertEqual(out.returncode, 0, out.stderr)
+        self.assertIn("OK", out.stdout)
+
+    @unittest.skipUnless(fusion.active(), "the hook rides the fused dispatch")
+    def test_hook_survives_garbage_without_breaking_the_dispatch(self):
+        # a hook crash must never take the dispatch down with it
+        a = self._split_input()
+        orig = numlens._record_stats
+        numlens._record_stats = lambda *args, **kw: (_ for _ in ()).throw(
+            RuntimeError("boom")
+        )
+        try:
+            got = float(ht.sum(a * 2.0))
+            self.assertTrue(np.isfinite(got))
+        finally:
+            numlens._record_stats = orig
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -842,6 +842,130 @@ def worker() -> None:
     except Exception:  # noqa: BLE001 - diagnostics must never cost the record
         pass
 
+    # serving leg (core/serving.py, ISSUE 15): the multi-tenant session
+    # layer's steady-state latency — p99 of one warm client vs p99 of 8
+    # concurrent session threads riding cross-session batching
+    # (serving_p99_ms_n1 / serving_p99_ms_n8), the retrace count during the
+    # N=8 measured phase (serving_steady_state_retraces — MUST stay 0: steady
+    # traffic never recompiles), and the persistent program cache's
+    # cross-process proof (serving_warm_start_compiles — a second process
+    # against the populated cache dir MUST record 0 compiles). Runs AFTER the
+    # record is banked (hang-safety invariant).
+    try:
+        import tempfile as _sv_tempfile
+        import threading as _sv_threading
+
+        from heat_tpu.core import fusion as _sv_fusion
+        from heat_tpu.core import serving as _serving
+
+        if chain_fused and _sv_fusion.active():
+
+            def _sv_chain(arr, k):
+                # one shared code object: leaf dedup is by identity, so the
+                # chain's signature is only reproducible when prebake and
+                # clients build it through the SAME constants
+                return float(ht.sum(arr * k + 1.0))
+
+            def _sv_input(seed):
+                _k = jax.random.PRNGKey(seed)
+                _n = (4096 // comm.size) * comm.size
+                return ht.array(
+                    jax.device_put(
+                        jax.random.normal(_k, (_n,), dtype=jnp.float32),
+                        comm.sharding(1, 0),
+                    ),
+                    is_split=0,
+                )
+
+            def _sv_p99(lats):
+                xs = sorted(lats)
+                return 1e3 * xs[min(len(xs) - 1, int(0.99 * len(xs)))]
+
+            _sv_rounds = 40
+            with _serving.Session("bench-n1"):
+                _sv_arr = _sv_input(70)
+                for _i in range(5):
+                    _sv_chain(_sv_arr, 1.0 + _i * 0.5)  # warm
+                _sv_lats1 = []
+                for _i in range(_sv_rounds):
+                    _t0 = time.perf_counter()
+                    _sv_chain(_sv_arr, 1.0 + _i * 0.5)
+                    _sv_lats1.append(time.perf_counter() - _t0)
+            record["serving_p99_ms_n1"] = round(_sv_p99(_sv_lats1), 3)
+
+            # prebake every batch-size signature 1..8, then 8 client threads
+            for _k in range(1, 9):
+                _outs = [
+                    ht.sum(_sv_input(80 + _j) * (1.0 + _j * 0.25) + 1.0)
+                    for _j in range(_k)
+                ]
+                for _o in _outs:
+                    float(_o)
+            _sv_before = _sv_fusion.cache_stats()["compiles"]
+            _sv_barrier = _sv_threading.Barrier(8)
+            _sv_all = [[] for _ in range(8)]
+
+            def _sv_client(idx):
+                with _serving.Session(f"bench-n8-{idx}"):
+                    arr = _sv_input(90 + idx)
+                    _sv_barrier.wait(timeout=60)
+                    for i in range(_sv_rounds):
+                        t0 = time.perf_counter()
+                        _sv_chain(arr, 1.0 + i * 0.25)
+                        _sv_all[idx].append(time.perf_counter() - t0)
+
+            _sv_threads = [
+                _sv_threading.Thread(target=_sv_client, args=(i,)) for i in range(8)
+            ]
+            for _t in _sv_threads:
+                _t.start()
+            for _t in _sv_threads:
+                _t.join()
+            record["serving_p99_ms_n8"] = round(
+                _sv_p99([v for lats in _sv_all for v in lats]), 3
+            )
+            record["serving_steady_state_retraces"] = int(
+                _sv_fusion.cache_stats()["compiles"] - _sv_before
+            )
+
+            # cross-process warm start: cold process populates the cache dir,
+            # warm process against it must record ZERO compiles
+            _sv_script = (
+                "import json, sys\n"
+                "import heat_tpu as ht\n"
+                "from heat_tpu.core import serving, fusion\n"
+                "import numpy as np\n"
+                "a = ht.array(np.arange(32, dtype=np.float32), split=0)\n"
+                "float(ht.sum(a * 3.0 + 1.0))\n"
+                "print(json.dumps(serving.cache_stats()))\n"
+            )
+            with _sv_tempfile.TemporaryDirectory() as _sv_dir:
+                _sv_env = dict(os.environ)
+                for _v in (
+                    "HEAT_TPU_FUSION", "HEAT_TPU_FAULTS", "HEAT_TPU_NUMLENS",
+                    "HEAT_TPU_MEMORY_BUDGET", "HEAT_TPU_TELEMETRY",
+                ):
+                    _sv_env.pop(_v, None)
+                _sv_env["HEAT_TPU_PROGRAM_CACHE_DIR"] = _sv_dir
+                _sv_env["JAX_PLATFORMS"] = "cpu"
+                _sv_out = None
+                for _ in range(2):  # cold run, then warm run
+                    _sv_proc = subprocess.run(
+                        [sys.executable, "-c", _sv_script], env=_sv_env,
+                        capture_output=True, text=True, timeout=240,
+                    )
+                    if _sv_proc.returncode == 0:
+                        _sv_out = json.loads(
+                            _sv_proc.stdout.strip().splitlines()[-1]
+                        )
+                if _sv_out is not None:
+                    record["serving_warm_start_compiles"] = int(
+                        _sv_out["compiles"]
+                    )
+            print(json.dumps(record), flush=True)  # last parseable line wins
+    except Exception:  # noqa: BLE001 - diagnostics must never cost the record
+        pass
+
     # static-analysis leg (heat_tpu/analysis, ISSUE 7): the AST lint's wall
     # time over the library (the pre-commit budget a CI hook would pay) and
     # the AOT program auditor's finding count over the program cache the
@@ -1458,6 +1582,22 @@ _ELASTIC_CEILINGS = {
     "steps_replayed_per_preempt": 5.0,
 }
 
+#: serving latency gauges with absolute ceilings (p99 ms of one warm client
+#: and of 8 concurrent session threads under cross-session batching); same
+#: ``max(ceiling, banked*1.5+2.0)`` noise logic as the overhead gauges
+_SERVING_CEILINGS = {
+    "serving_p99_ms_n1": 10.0,
+    "serving_p99_ms_n8": 25.0,
+}
+
+#: serving counters that must be EXACTLY zero — steady-state traffic never
+#: recompiles and a warm process against a populated cache dir never
+#: compiles; no noise slack applies (a retrace is a bug, not jitter)
+_SERVING_ZERO_KEYS = (
+    "serving_steady_state_retraces",
+    "serving_warm_start_compiles",
+)
+
 
 def _load_record(path: str) -> dict:
     """A bench record from disk — unwraps the round-artifact envelope
@@ -1571,6 +1711,25 @@ def compare_records(fresh: dict, banked: dict, slack: float = 0.30) -> dict:
                 f"{key}: fresh {f:g} > limit {limit:g} (monotone-quality metric: "
                 f"ceiling {ceiling:g}, banked {b if b is not None else 'n/a'} "
                 "+ 2pt noise — the rate slack does not apply)"
+            )
+    for key, ceiling in _SERVING_CEILINGS.items():
+        f, b = _num(fresh, key), _num(banked, key)
+        if f is None:
+            if b is not None:
+                notes.append(f"{key}: banked={b:g} but missing from fresh record")
+            continue
+        limit = ceiling if b is None else max(ceiling, b * 1.5 + 2.0)
+        if f > limit:
+            regressions.append(
+                f"{key}: fresh {f:g} > limit {limit:g} "
+                f"(ceiling {ceiling:g}, banked {b if b is not None else 'n/a'})"
+            )
+    for key in _SERVING_ZERO_KEYS:
+        f = _num(fresh, key)
+        if f is not None and f != 0:
+            regressions.append(
+                f"{key}: fresh {f:g} != 0 (strict-zero serving invariant: "
+                "steady state never retraces, warm starts never compile)"
             )
     for key in _MONOTONE_KEYS:
         f, b = _num(fresh, key), _num(banked, key)

@@ -33,6 +33,7 @@ from .core import (
     resilience,
     rounding,
     sanitation,
+    serving,
     signal,
     statistics,
     stride_tricks,

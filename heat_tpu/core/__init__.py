@@ -25,6 +25,7 @@ from . import tracelens
 from . import numlens
 from . import fusion
 from . import elastic
+from . import serving
 from .dndarray import *
 from .factories import *
 from .memory import *

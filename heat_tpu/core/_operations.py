@@ -32,7 +32,7 @@ def _nonfinite_checked(res: DNDarray) -> DNDarray:
     never reaches a forcing point, so the check runs on the op's own logical
     result — per-op error locality, exactly the reference's model. One
     module-attribute read when the policy is off."""
-    if resilience._ERRSTATE is not None:
+    if resilience._ERRSTATE is not None or resilience._TLS_ARMED:
         resilience.check_nonfinite(res.larray, "eager")
     return res
 

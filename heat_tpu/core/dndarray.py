@@ -228,7 +228,7 @@ class DNDarray:
             split = self.__split
             if split is not None and (arr.ndim == 0 or split >= arr.ndim):
                 split = None
-            if resilience._ERRSTATE is not None:
+            if resilience._ERRSTATE is not None or resilience._TLS_ARMED:
                 # numeric error policy at the forcing seam, on the LOGICAL
                 # extent only: the padding suffix of a ragged split holds
                 # unspecified garbage (log(0) = -inf) and must not be

@@ -102,6 +102,7 @@ import functools
 import hashlib
 import itertools
 import os
+import threading
 import time
 import weakref
 from collections import OrderedDict
@@ -248,7 +249,7 @@ class LazyArray:
     """
 
     __slots__ = ("fn", "children", "kw", "shape", "dtype", "depth", "cid",
-                 "program", "_value")
+                 "program", "session", "_value")
 
     def __init__(self, fn, children, kw, shape, dtype, depth, cid=0):
         self.fn = fn
@@ -259,6 +260,10 @@ class LazyArray:
         self.depth = depth
         self.cid = cid
         self.program = None
+        # the serving session (name) this node was recorded under, or None —
+        # cross-session batching preserves it per root so tracelens/SLO
+        # histograms bill the right tenant even inside a shared dispatch
+        self.session = None
         self._value = None
 
     @property
@@ -369,7 +374,10 @@ def record(fn, children, **kw) -> LazyArray:
         telemetry.record_event(
             "record", op=getattr(fn, "__name__", str(fn)), cid=cid, depth=depth
         )
-    return LazyArray(fn, tuple(children), kw_t, shape, dtype, depth, cid)
+    node = LazyArray(fn, tuple(children), kw_t, shape, dtype, depth, cid)
+    if _SESSION_OF is not None:
+        node.session = _SESSION_OF()
+    return node
 
 
 def cast(c, jax_dtype) -> LazyArray:
@@ -407,11 +415,35 @@ _REPL_COSTS: dict = {}
 _STATS = {
     "compiles": 0,
     "hits": 0,
+    "disk_hits": 0,
     "forces": 0,
     "evictions": 0,
     "degraded": 0,
     "quarantine_hits": 0,
 }
+
+# serving seams (core/serving.py installs these as module attributes — the
+# telemetry ``_MEM_HOOK`` set-attribute pattern; each costs one ``is None``
+# check per force when the serving layer is not in use):
+_DISK_INDEX = None  # persistent program-key index: disk warm-start accounting
+_ADMIT_HOOK = None  # token-bucket admission gate, composed BEFORE memledger's
+_SERVING_NOTE = None  # per-session incident/billing notes
+_SESSION_OF = None  # resolves the calling thread's active Session id
+
+# micro batch window (seconds): when serving arms this (>= 2 concurrent
+# sessions), a top-level force sleeps this long BEFORE taking _FORCE_LOCK.
+# The sleep releases the GIL so other client threads can register their own
+# pending roots; the first thread to wake gathers every root registered in
+# the window into ONE multi-output dispatch, and the absorbed threads find
+# their value already installed without ever contending on the lock.
+_BATCH_WINDOW_S = 0.0
+_FORCE_TLS = threading.local()  # .held: this thread is inside force already
+
+# force() serializes its walk/cache/dispatch critical section under ONE
+# reentrant lock so concurrent serving clients never interleave signature
+# accumulators or the program-cache LRU. RLock, not Lock: the memledger
+# drain policy recursively forces OTHER roots from inside the gate.
+_FORCE_LOCK = threading.RLock()
 
 
 def _program_key(sig) -> str:
@@ -590,7 +622,11 @@ def _gather_batch(entries, leaves, memo, roots):
     small results batch (a big disjoint root keeps its own dispatch), and
     only candidates living on the SAME device set as the triggering root's
     leaves — one jitted program cannot span two meshes, and a mixed batch
-    would dispatch-fail and spuriously degrade a perfectly valid chain."""
+    would dispatch-fail and spuriously degrade a perfectly valid chain.
+    Roots recorded under DIFFERENT serving sessions batch together freely
+    (the registry is global and the rules above are session-blind): each
+    root carries its ``session`` stamp, so the shared dispatch still bills
+    per tenant through the serving note and the timeline's ``sessions``."""
     device_set = None
     for leaf in leaves:
         if isinstance(leaf, jax.Array):
@@ -707,6 +743,10 @@ def _degrade(sig, leaves, exc, missed):
     _STATS["degraded"] += 1
     stage = "compile" if missed else "execute"
     family = _family(sig)
+    if _SERVING_NOTE is not None:
+        # contained per-session: only the tripping tenant's quarantine view
+        # records the incident — a neighbor's programs stay undegraded
+        _SERVING_NOTE("degraded", program=_program_key(sig), stage=stage)
     if telemetry._MODE:
         telemetry.record_degraded(family, stage, repr(exc))
     warnings.warn(
@@ -750,6 +790,28 @@ def force(node):
         return node
     if node._value is not None:
         return node._value
+    # micro batch window (serving arms it): sleep with the GIL released so
+    # concurrent clients can register their roots, then re-check — a
+    # neighbour's batch may have materialized this node during the window.
+    # Skipped on recursive forces (drain policy) which already hold the lock.
+    if _BATCH_WINDOW_S > 0.0 and not getattr(_FORCE_TLS, "held", 0):
+        time.sleep(_BATCH_WINDOW_S)
+        if node._value is not None:
+            return node._value
+    # one force at a time: concurrent serving clients serialize here (the
+    # lock is reentrant for the drain policy's recursive forces). Re-check
+    # after acquiring — another thread's batch may have materialized us.
+    with _FORCE_LOCK:
+        _FORCE_TLS.held = getattr(_FORCE_TLS, "held", 0) + 1
+        try:
+            return _force_locked(node)
+        finally:
+            _FORCE_TLS.held -= 1
+
+
+def _force_locked(node):
+    if node._value is not None:
+        return node._value
     roots = [node]
     entries = []
     leaves = []
@@ -768,6 +830,11 @@ def force(node):
     if _QUARANTINE and sig in _QUARANTINE:
         # known-bad DAG key: skip the failing compile, replay per-op
         _STATS["quarantine_hits"] += 1
+        if _SERVING_NOTE is not None:
+            _SERVING_NOTE(
+                "quarantine_hit", program=_program_key(sig), cid=node.cid,
+                sessions=[getattr(r, "session", None) for r in roots],
+            )
         if telemetry._MODE:
             telemetry.record_force(
                 telemetry.current_trigger(), node.depth, compiled=False, cid=node.cid
@@ -780,12 +847,22 @@ def force(node):
         if missed:
             prog = jax.jit(_build(sig))
             _PROGRAMS[sig] = prog
-            _STATS["compiles"] += 1
-            info["compiles"] += 1
+            # a key already in the persistent index is a DISK hit, not a
+            # recompile: jax's compilation cache (wired to the same
+            # HEAT_TPU_PROGRAM_CACHE_DIR) serves the compiled binary, so a
+            # warm-started process records zero compiles for seen signatures
+            disk_warm = _DISK_INDEX is not None and _DISK_INDEX.has(info["key"])
+            if disk_warm:
+                _STATS["disk_hits"] += 1
+            else:
+                _STATS["compiles"] += 1
+                info["compiles"] += 1
+            if _DISK_INDEX is not None:
+                _DISK_INDEX.note(info["key"], info["family"])
             while len(_PROGRAMS) > _CACHE_SIZE:
                 _PROGRAMS.popitem(last=False)
                 _STATS["evictions"] += 1
-            if telemetry._MODE:
+            if telemetry._MODE and not disk_warm:
                 telemetry.record_retrace(_family(sig), _leaf_key(sig))
                 # lands on the verbose timeline AND the flight ring (the
                 # black box wants compiles next to the dispatches they cost)
@@ -800,6 +877,16 @@ def force(node):
             telemetry.record_force(
                 telemetry.current_trigger(), node.depth, compiled=missed, cid=node.cid
             )
+        if _ADMIT_HOOK is not None:
+            # serving admission gate (core/serving.py): per-session + global
+            # token buckets at the SAME pre-dispatch seam as memledger's
+            # headroom gate (and before it — cheap rate math before ledger
+            # walks). A refusal surfaces AdmissionError with the chain
+            # intact: still pending, never degraded, dispatchable once
+            # tokens refill — exactly the admission_hold contract.
+            _ADMIT_HOOK(info["key"], node.cid, len(roots))
+            if node._value is not None:  # pragma: no cover - belt and braces
+                return node._value
         if memledger._BUDGET_RAW is not None or memledger._HOLD is not None:
             # headroom admission gate (core/memledger.py): live ledger bytes
             # + this program's static peak against HEAT_TPU_MEMORY_BUDGET.
@@ -813,10 +900,20 @@ def force(node):
             # drain policy must not let another force's batch absorb any of
             # them — the program below is already built over this walk
             exclude = frozenset(memo)
-            memledger.admit(
-                info["key"], info["family"], peak, peak_src,
-                drain_fn=lambda: _drain_pending_roots(exclude),
-            )
+            try:
+                memledger.admit(
+                    info["key"], info["family"], peak, peak_src,
+                    drain_fn=lambda: _drain_pending_roots(exclude),
+                )
+            except memledger.MemoryBudgetExceeded:
+                if _SERVING_NOTE is not None:
+                    # the refusal is billed to the refused tenant only —
+                    # containment: neighbors never see this session's gate
+                    _SERVING_NOTE(
+                        "mem_refused", program=info["key"], cid=node.cid,
+                        sessions=[getattr(r, "session", None) for r in roots],
+                    )
+                raise
             if node._value is not None:  # pragma: no cover - belt and braces
                 # some recursive path materialized this very chain while the
                 # gate held it: the dispatch is done, do not run it again
@@ -891,12 +988,26 @@ def force(node):
         # values — one attribute check when disarmed, and the hook itself
         # never raises and skips tracer values
         telemetry._NUMLENS_HOOK(sig, leaves, roots, values, info)
+    sessions = None
+    if _SESSION_OF is not None or any(
+        getattr(r, "session", None) is not None for r in roots
+    ):
+        sessions = [getattr(r, "session", None) for r in roots]
+    if _SERVING_NOTE is not None and info is not None:
+        # per-tenant billing for a (possibly cross-session) shared dispatch:
+        # each session is charged for ITS roots, and the compile (if any)
+        # for the triggering session only
+        _SERVING_NOTE(
+            "dispatch", program=info["key"], sessions=sessions,
+            compiled=missed, trigger=getattr(node, "session", None),
+        )
     if telemetry._MODE:
         telemetry.record_async_dispatch(
             len(roots),
             cid=node.cid,
             cids=[r.cid for r in roots],
             program=None if info is None else info["key"],
+            sessions=sessions,
         )
     return values[0]
 
@@ -909,9 +1020,13 @@ def is_deferred(x) -> bool:
 
 def cache_stats() -> dict:
     """Program-cache counters: ``compiles`` (the retrace count the
-    compile-count tests pin), ``hits``, ``forces``, ``misses`` (an alias of
-    ``compiles`` — every miss compiles, counted once), ``evictions`` (LRU
-    drops past ``HEAT_TPU_FUSION_CACHE``), the current cache ``size``, the
+    compile-count tests pin — a disk warm-start is NOT a compile), ``hits``
+    (in-memory), ``disk_hits`` (first force of a signature whose key was in
+    the persistent ``HEAT_TPU_PROGRAM_CACHE_DIR`` index — the compiled
+    binary comes from jax's compilation cache), ``forces``, ``misses``
+    (``compiles + disk_hits`` — every cache-structure miss, however the
+    binary was then obtained), ``evictions`` (LRU drops past
+    ``HEAT_TPU_FUSION_CACHE``), the current cache ``size``, the
     ``program_keys`` of every cached program (the digests the trace
     timeline's ``dispatch`` events correlate to), plus the guarded-forcing
     counters: ``degraded`` (programs that failed and were replayed per-op),
@@ -919,7 +1034,7 @@ def cache_stats() -> dict:
     ``quarantined`` (currently quarantined keys)."""
     return dict(
         _STATS,
-        misses=_STATS["compiles"],
+        misses=_STATS["compiles"] + _STATS["disk_hits"],
         size=len(_PROGRAMS),
         quarantined=len(_QUARANTINE),
         program_keys=[info["key"] for info in _PROGRAM_INFO.values()],
@@ -938,7 +1053,8 @@ def clear_cache() -> None:
     _QUARANTINE.clear()
     _LIVE_ROOTS.clear()
     _STATS.update(
-        compiles=0, hits=0, forces=0, evictions=0, degraded=0, quarantine_hits=0
+        compiles=0, hits=0, disk_hits=0, forces=0, evictions=0, degraded=0,
+        quarantine_hits=0,
     )
 
 

@@ -412,24 +412,55 @@ def _merge_hstate(dst: _HState, src: _HState) -> None:
 
 
 _H_GLOBAL = _HState()
-_H_STATES: List[_HState] = [_H_GLOBAL]
 #: completed-scope accumulators, keyed by scope path (re-entry accumulates)
 _H_SCOPES: Dict[str, _HState] = {}
+
+# The scope stack is THREAD-LOCAL, mirroring telemetry's: concurrent serving
+# sessions each scope their own histograms, records roll up into the shared
+# global tables, and the archive merge runs under _H_LOCK.
+_H_TLS = threading.local()
+_H_GLOBAL_ONLY = (_H_GLOBAL,)
+#: every scope state active on ANY thread (reset() must clear them all)
+_H_ACTIVE: List[_HState] = []
+_H_LOCK = threading.Lock()
+
+
+def _h_stack() -> List[_HState]:
+    stack = getattr(_H_TLS, "scopes", None)
+    if stack is None:
+        stack = _H_TLS.scopes = []
+    return stack
+
+
+def _h_states():
+    stack = getattr(_H_TLS, "scopes", None)
+    if not stack:
+        return _H_GLOBAL_ONLY
+    return [_H_GLOBAL] + stack
 
 
 def _push_scope(path: str) -> None:
     """``telemetry.scope`` seam: scope the histograms alongside the counters."""
-    _H_STATES.append(_HState(path))
+    st = _HState(path)
+    _h_stack().append(st)
+    with _H_LOCK:
+        _H_ACTIVE.append(st)
 
 
 def _pop_scope(path: str) -> None:
-    for i in range(len(_H_STATES) - 1, 0, -1):  # never pop the global state
-        if _H_STATES[i].path == path:
-            st = _H_STATES.pop(i)
-            acc = _H_SCOPES.get(path)
-            if acc is None:
-                acc = _H_SCOPES[path] = _HState(path)
-            _merge_hstate(acc, st)
+    stack = _h_stack()
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i].path == path:
+            st = stack.pop(i)
+            with _H_LOCK:
+                for j in range(len(_H_ACTIVE) - 1, -1, -1):
+                    if _H_ACTIVE[j] is st:
+                        del _H_ACTIVE[j]
+                        break
+                acc = _H_SCOPES.get(path)
+                if acc is None:
+                    acc = _H_SCOPES[path] = _HState(path)
+                _merge_hstate(acc, st)
             return
 
 
@@ -437,7 +468,7 @@ def _observe(metric: str, key: Optional[str], v: float) -> None:
     """Fold one latency sample into every active state ('*' overall row +
     the per-key row) and the SLO window. ``key`` is the sync trigger or the
     program key (None observes the overall row only)."""
-    for st in _H_STATES:
+    for st in _h_states():
         st.overall[metric].observe(v)
         if key is None:
             continue
@@ -886,7 +917,7 @@ def health_block(global_view: bool = False) -> Dict[str, Any]:
     row; ``dispatch``/``compile`` keyed by program key, ``sync`` by
     trigger), and the rolling SLO gauges. Inside a ``telemetry.scope`` the
     histograms are the scope's own isolated view unless ``global_view``."""
-    st = _H_GLOBAL if global_view else _H_STATES[-1]
+    st = _H_GLOBAL if global_view else _h_states()[-1]
     return {
         "flight": flight_stats(),
         "watchdog": dict(watchdog_stats(), last_stall=last_stall()),
@@ -910,9 +941,11 @@ def reset() -> None:
     _LAST_DUMP = None
     _LAST_AUTO_DUMP_TS.clear()
     _DISPATCHED.clear()
-    for st in _H_STATES:
-        st.clear()
-    _H_SCOPES.clear()
+    _H_GLOBAL.clear()
+    with _H_LOCK:
+        for st in list(_H_ACTIVE):
+            st.clear()
+        _H_SCOPES.clear()
     for dq in _SLO_SAMPLES.values():
         dq.clear()
     for m in _SLO_BREACHES:

@@ -136,6 +136,51 @@ _TRAINING: Dict[str, Dict[str, Any]] = {}
 _FINDINGS: deque = deque(maxlen=_FINDING_CAP)
 _IN_HOOK = False
 
+# Per-session sampling frames (the serving ``Session`` seam): each frame is
+# thread-local and carries its own mode override + seen/sampled counters so
+# concurrent sessions sample independently — one session in ``full`` mode
+# never changes a neighbor's cadence, and per-session counters report which
+# tenant paid for stats. ``_SESSION_ARMED`` counts armed frames process-wide
+# so the dispatch hook stays installed while any session needs it.
+_NL_TLS = threading.local()
+_SESSION_ARMED = 0
+
+
+def _push_session(mode_override=None) -> Dict[str, Any]:
+    """Push a thread-local sampling frame. ``mode_override`` of ``None``
+    inherits the global mode (but still gets isolated counters); otherwise
+    it shadows ``_MODE`` for dispatches on the pushing thread."""
+    global _SESSION_ARMED
+    stack = getattr(_NL_TLS, "frames", None)
+    if stack is None:
+        stack = _NL_TLS.frames = []
+    frame: Dict[str, Any] = {
+        "mode": None if mode_override is None else _parse_mode(mode_override),
+        "seen": 0,
+        "sampled": 0,
+    }
+    stack.append(frame)
+    if frame["mode"]:
+        with _LOCK:
+            _SESSION_ARMED += 1
+            if telemetry._NUMLENS_HOOK is None:
+                telemetry._NUMLENS_HOOK = _on_dispatch
+    return frame
+
+
+def _pop_session() -> Optional[Dict[str, Any]]:
+    global _SESSION_ARMED
+    stack = getattr(_NL_TLS, "frames", None)
+    if not stack:
+        return None
+    frame = stack.pop()
+    if frame["mode"]:
+        with _LOCK:
+            _SESSION_ARMED -= 1
+            if not _SESSION_ARMED and not _MODE:
+                telemetry._NUMLENS_HOOK = None
+    return frame
+
 
 def mode() -> str:
     """Current mode name: ``off`` / ``sample`` / ``full``."""
@@ -156,7 +201,7 @@ def set_mode(new_mode) -> int:
     global _MODE
     prev = _MODE
     _MODE = _parse_mode(new_mode)
-    telemetry._NUMLENS_HOOK = _on_dispatch if _MODE else None
+    telemetry._NUMLENS_HOOK = _on_dispatch if (_MODE or _SESSION_ARMED) else None
     return prev
 
 
@@ -697,11 +742,23 @@ def _on_dispatch(sig, leaves, roots, values, info) -> None:
     trace, and guards against re-entrancy (the canary dispatches its own
     jitted program)."""
     global _SEEN, _SAMPLED, _IN_HOOK
-    if not _MODE or _IN_HOOK or info is None:
+    frames = getattr(_NL_TLS, "frames", None)
+    frame = frames[-1] if frames else None
+    # the innermost session frame's mode shadows the global one for
+    # dispatches on this thread; a frame without an override inherits it
+    eff_mode = _MODE
+    if frame is not None and frame["mode"] is not None:
+        eff_mode = frame["mode"]
+    if not eff_mode or _IN_HOOK or info is None:
         return
     _SEEN += 1
-    every = 1 if _MODE >= 2 else max(1, _SAMPLE_EVERY)
-    if (_SEEN - 1) % every:
+    if frame is not None:
+        frame["seen"] += 1
+    every = 1 if eff_mode >= 2 else max(1, _SAMPLE_EVERY)
+    # sessions with their own mode sample on their own cadence — a
+    # neighbor's traffic never advances (or stalls) this tenant's stride
+    seen = frame["seen"] if (frame is not None and frame["mode"] is not None) else _SEEN
+    if (seen - 1) % every:
         return
     _IN_HOOK = True
     try:
@@ -711,6 +768,8 @@ def _on_dispatch(sig, leaves, roots, values, info) -> None:
             if isinstance(v, jax.core.Tracer):
                 return
         _SAMPLED += 1
+        if frame is not None:
+            frame["sampled"] += 1
         _record_stats(info["key"], info.get("family", "?"), values, roots)
         if _SHADOW_EVERY > 0 and _SAMPLED % _SHADOW_EVERY == 0:
             _shadow_audit(sig, leaves, values, info)

@@ -106,6 +106,7 @@ import os
 import random
 import re
 import shutil
+import threading
 import time
 import warnings
 from contextlib import contextmanager
@@ -538,6 +539,48 @@ _env_nonfinite = os.environ.get("HEAT_TPU_NONFINITE", "ignore").strip().lower()
 if _env_nonfinite in ("warn", "raise"):
     _ERRSTATE = _env_nonfinite
 
+# Per-session (thread-local) overrides: a serving ``Session`` pushes a policy
+# that applies only to its own thread, layered over the global ``_ERRSTATE``.
+# ``_TLS_ARMED`` counts pushed overrides process-wide so the hot-path gates
+# (``_operations._nonfinite_checked``, ``DNDarray.larray``) stay a single
+# module-attribute read when no session override is active anywhere.
+_ERR_TLS = threading.local()
+_TLS_ARMED = 0
+_TLS_LOCK = threading.Lock()
+
+
+def _push_errstate(mode: Optional[str]) -> None:
+    """Push a thread-local nonfinite policy (the serving ``Session`` seam).
+
+    ``mode`` is ``None`` (= "ignore"), ``"warn"`` or ``"raise"`` and applies
+    to the calling thread ONLY, shadowing the global policy until popped."""
+    global _TLS_ARMED
+    stack = getattr(_ERR_TLS, "stack", None)
+    if stack is None:
+        stack = _ERR_TLS.stack = []
+    stack.append(mode)
+    with _TLS_LOCK:
+        _TLS_ARMED += 1
+
+
+def _pop_errstate() -> None:
+    global _TLS_ARMED
+    stack = getattr(_ERR_TLS, "stack", None)
+    if stack:
+        stack.pop()
+        with _TLS_LOCK:
+            _TLS_ARMED -= 1
+
+
+def _effective_errstate() -> Optional[str]:
+    """The policy in force for the calling thread: the innermost session
+    override when one is pushed, else the global ``ht.errstate`` policy."""
+    stack = getattr(_ERR_TLS, "stack", None)
+    if stack:
+        return stack[-1]
+    return _ERRSTATE
+
+
 _isfinite_prog = None
 
 
@@ -580,15 +623,15 @@ class errstate:
 def check_nonfinite(value, where: str = "force", *, program=None, cid=None) -> None:
     """Apply the active ``errstate`` policy to a materialized array.
 
-    Call sites gate on ``resilience._ERRSTATE`` (one attribute read when the
-    policy is off). Inexact dtypes only; the reduction is one jitted
+    Call sites gate on ``resilience._ERRSTATE``/``_TLS_ARMED`` (two module
+    attribute reads when no policy is active anywhere). Inexact dtypes only; the reduction is one jitted
     ``all(isfinite(x))`` — jit caches one tiny program per shape/sharding,
     and the scalar read is the only sync added. ``program``/``cid`` carry
     the provenance of the producing fused dispatch (the program key stamped
     on the root at force time and the chain's correlation id) so the
     warning/raise names WHICH program manufactured the inf/NaN instead of
     just where it was caught."""
-    mode = _ERRSTATE
+    mode = _effective_errstate()
     if mode is None:
         return
     dtype = getattr(value, "dtype", None)

@@ -1,0 +1,820 @@
+"""Multi-tenant serving layer: sessions, persistent program cache, admission.
+
+The north star is heavy traffic from many concurrent short-lived client
+computations sharing ONE warm mesh (ROADMAP item 4) — not one long SPMD
+script. Everything a service needs around the fused dispatch path already
+exists in pieces (scoped telemetry, memledger's headroom gate and hold
+semantics, fusion's per-program-key ledger); this module composes them into
+a serving surface with three pillars:
+
+**Sessions** (:class:`Session`) — one per client/tenant, entered as a
+context manager on the client's thread. A session gets its own telemetry
+scope (counters, spans, scoped latency histograms via the
+``health_runtime`` seam), its own numeric error policy
+(``resilience``' thread-local errstate override), its own numerics-lens
+sampling frame, and its own quarantine view (degraded programs and
+quarantine hits are billed to the tripping tenant, never a neighbor).
+State never bleeds between concurrent client threads: the scope/errstate/
+sampling machinery is thread-local, and the global rollup stays intact
+underneath.
+
+**Persistent program cache** — ``HEAT_TPU_PROGRAM_CACHE_DIR`` (or
+:func:`arm_cache`) wires jax's compilation cache to ``<dir>/xla`` and keeps
+an append-only index of fusion's DAG-signature program keys in
+``<dir>/programs.jsonl``. A fresh process that forces a previously-seen
+signature records a ``disk_hit`` instead of a ``compile`` (the compiled
+binary comes off disk), so a warm-started service reaches steady state with
+zero recompiles; :func:`warmup` pre-bakes representative chains ahead of
+traffic. A malformed dir (unwritable, file-not-dir) warns and disarms at
+import — the ``HEAT_TPU_MEMORY_BUDGET`` convention: a typo'd env knob must
+not take the process down. Corrupt index lines are skipped with one warning.
+
+**Admission control** — a token-bucket gate on fused dispatches
+(``HEAT_TPU_ADMISSION_RATE`` tokens/s, ``HEAT_TPU_ADMISSION_BURST`` bucket
+depth), with one global bucket and optionally one per session, installed at
+the SAME pre-dispatch seam as memledger's headroom gate and composed before
+it. A refused chain stays fully intact — still pending, never degraded,
+never double-dispatched — exactly the ``admission_hold`` contract: under
+the default ``wait`` policy the force blocks until tokens refill, under
+``raise`` (``HEAT_TPU_ADMISSION_POLICY=raise``) an :class:`AdmissionError`
+names the session and the bucket that refused.
+
+**Cross-session batching** costs nothing extra: fusion's live-root registry
+is global, so small pending roots from different sessions ride one
+multi-output dispatch under the same comm/device-set rules. Each root
+carries its recording session's name, the dispatch timeline event carries
+the ``sessions`` list, and the serving note bills each tenant for its own
+roots — shared dispatch, per-tenant attribution.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+import warnings
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional
+
+from . import fusion, memledger, numlens, resilience, telemetry
+
+__all__ = [
+    "AdmissionError",
+    "Session",
+    "arm_cache",
+    "cache_stats",
+    "sessions_block",
+    "session_reports",
+    "set_admission",
+    "warmup",
+    "reset",
+]
+
+
+class AdmissionError(RuntimeError):
+    """A fused dispatch exceeded the admission token bucket under the
+    ``raise`` policy. The message names the session and the bucket
+    (``global`` or ``session:<name>``) that refused; the chain it refused
+    is untouched — still pending, dispatchable once tokens refill."""
+
+
+# ----------------------------------------------------------------------
+# token buckets
+# ----------------------------------------------------------------------
+class _TokenBucket:
+    """Classic token bucket: ``rate`` tokens/second refill up to ``burst``
+    capacity; one fused dispatch costs one token. ``take`` never sleeps —
+    it returns the seconds until a token WILL be available so the caller
+    owns the wait/raise decision (and the bookkeeping)."""
+
+    __slots__ = ("name", "rate", "burst", "tokens", "ts",
+                 "admitted", "refused", "waited_s", "_lock")
+
+    def __init__(self, rate: float, burst: float, name: str):
+        self.name = name
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst))
+        self.tokens = self.burst  # starts full: the first burst is free
+        self.ts = time.monotonic()
+        self.admitted = 0
+        self.refused = 0
+        self.waited_s = 0.0
+        self._lock = threading.Lock()
+
+    def take(self) -> float:
+        """Take one token if available (returns 0.0), else the seconds
+        until the bucket refills enough."""
+        with self._lock:
+            now = time.monotonic()
+            self.tokens = min(self.burst, self.tokens + (now - self.ts) * self.rate)
+            self.ts = now
+            if self.tokens >= 1.0:
+                self.tokens -= 1.0
+                self.admitted += 1
+                return 0.0
+            return (1.0 - self.tokens) / self.rate if self.rate > 0 else 60.0
+
+    def give_back(self) -> None:
+        """Refund a taken token (a later bucket in the chain refused)."""
+        with self._lock:
+            self.tokens = min(self.burst, self.tokens + 1.0)
+            self.admitted -= 1
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "rate": self.rate,
+            "burst": self.burst,
+            "admitted": self.admitted,
+            "refused": self.refused,
+            "waited_s": round(self.waited_s, 6),
+        }
+
+
+# ----------------------------------------------------------------------
+# env knobs (warn-and-disarm, the HEAT_TPU_MEMORY_BUDGET convention)
+# ----------------------------------------------------------------------
+_POLICIES = ("wait", "raise")
+
+
+def _parse_env_rate(name: str) -> Optional[float]:
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return None
+    try:
+        rate = float(raw)
+        if rate <= 0:
+            raise ValueError("rate must be > 0")
+        return rate
+    except (ValueError, TypeError):
+        warnings.warn(
+            f"{name}={raw!r} is not a positive tokens/second number; the "
+            "admission gate stays disarmed",
+            stacklevel=1,
+        )
+        return None
+
+
+def _parse_env_burst(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        burst = float(raw)
+        if burst < 1:
+            raise ValueError("burst must be >= 1")
+        return burst
+    except (ValueError, TypeError):
+        warnings.warn(
+            f"{name}={raw!r} is not a bucket depth >= 1; using {default}",
+            stacklevel=1,
+        )
+        return default
+
+
+def _parse_env_policy() -> str:
+    raw = os.environ.get("HEAT_TPU_ADMISSION_POLICY", "wait").strip().lower() or "wait"
+    if raw not in _POLICIES:  # a typo'd env knob must not take the process down
+        warnings.warn(
+            f"HEAT_TPU_ADMISSION_POLICY={raw!r} is not one of {_POLICIES}; "
+            "using 'wait'",
+            stacklevel=1,
+        )
+        return "wait"
+    return raw
+
+
+def _parse_env_cache_dir() -> Optional[str]:
+    """``HEAT_TPU_PROGRAM_CACHE_DIR``, probed writable. An unwritable path
+    or a file-where-a-dir-should-be warns and disarms instead of making
+    ``import heat_tpu`` raise."""
+    raw = os.environ.get("HEAT_TPU_PROGRAM_CACHE_DIR")
+    if raw is None or not raw.strip():
+        return None
+    path = raw.strip()
+    try:
+        os.makedirs(path, exist_ok=True)
+        probe = os.path.join(path, ".ht_probe")
+        with open(probe, "w"):
+            pass
+        os.remove(probe)
+    except OSError as exc:
+        warnings.warn(
+            f"HEAT_TPU_PROGRAM_CACHE_DIR={raw!r} is not a writable directory "
+            f"({exc}); the persistent program cache stays disarmed",
+            stacklevel=1,
+        )
+        return None
+    return path
+
+
+# ----------------------------------------------------------------------
+# the persistent program-key index
+# ----------------------------------------------------------------------
+class _DiskIndex:
+    """``programs.jsonl`` under the cache dir: one ``{"key", "family"}``
+    line appended per first-compiled program. The index is what lets a
+    fresh process distinguish "first compile ever" from "seen before, the
+    binary is in jax's on-disk compilation cache" — fusion counts the
+    latter as ``disk_hits``, keeping the compile counter an honest retrace
+    count across process restarts. Corrupt lines (partial writes, stray
+    bytes) are skipped with ONE warning, never a crash."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.keys: Dict[str, str] = {}  # key -> family
+        self.loaded = 0
+        self.skipped = 0
+        self._warned = False
+        self._lock = threading.Lock()
+
+    def load(self) -> None:
+        try:
+            with open(self.path, "r") as fh:
+                lines = fh.readlines()
+        except FileNotFoundError:
+            return
+        except OSError as exc:
+            self._warn_once(f"unreadable ({exc})")
+            return
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+                key = rec["key"]
+                if not isinstance(key, str) or not key:
+                    raise ValueError("bad key")
+            except (ValueError, KeyError, TypeError):
+                self.skipped += 1
+                self._warn_once(f"corrupt entry {line[:60]!r}")
+                continue
+            if key not in self.keys:
+                self.keys[key] = str(rec.get("family", "?"))
+                self.loaded += 1
+
+    def _warn_once(self, what: str) -> None:
+        if not self._warned:
+            self._warned = True
+            warnings.warn(
+                f"persistent program index {self.path}: {what} — skipping "
+                "(the cache keeps working; bad entries just recompile)",
+                stacklevel=2,
+            )
+
+    def has(self, key: str) -> bool:
+        return key in self.keys
+
+    def note(self, key: str, family: str) -> None:
+        """Record a program key (idempotent; append-only on disk)."""
+        with self._lock:
+            if key in self.keys:
+                return
+            self.keys[key] = family
+            try:
+                with open(self.path, "a") as fh:
+                    fh.write(json.dumps({"key": key, "family": family}) + "\n")
+            except OSError as exc:
+                self._warn_once(f"append failed ({exc})")
+
+
+# ----------------------------------------------------------------------
+# module state
+# ----------------------------------------------------------------------
+_LOCK = threading.Lock()
+_TLS = threading.local()  # per-thread stack of active Sessions
+_SESSION_SEQ = itertools.count(1)
+#: every session ever entered this telemetry session, active or exited,
+#: keyed by name (the archive the CLI `sessions` verb renders)
+_SESSIONS: "OrderedDict[str, Session]" = OrderedDict()
+_ACTIVE = 0  # entered-and-not-exited count, across all threads
+
+_CACHE_DIR: Optional[str] = None
+_INDEX: Optional[_DiskIndex] = None
+_XLA_CACHE_WIRED = False
+_XLA_PREV_CONFIG = None  # jax cache config to restore on disarm_cache()
+
+_GLOBAL_BUCKET: Optional[_TokenBucket] = None
+_POLICY = _parse_env_policy()
+_ENV_RATE = _parse_env_rate("HEAT_TPU_ADMISSION_RATE")
+_ENV_BURST = _parse_env_burst(
+    "HEAT_TPU_ADMISSION_BURST", _ENV_RATE if _ENV_RATE is not None else 1.0
+)
+
+
+def _session_stack() -> List["Session"]:
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    return stack
+
+
+def _current_session() -> Optional["Session"]:
+    stack = getattr(_TLS, "stack", None)
+    return stack[-1] if stack else None
+
+
+def _current_session_name() -> Optional[str]:
+    stack = getattr(_TLS, "stack", None)
+    return stack[-1].name if stack else None
+
+
+# ----------------------------------------------------------------------
+# the fusion seams (set-attribute hooks, installed while sessions exist)
+# ----------------------------------------------------------------------
+def _bill(names, field: str, per_root: bool = False) -> None:
+    """Charge ``field`` once per distinct session in ``names`` (or per root
+    when ``per_root``), resolving names through the registry."""
+    if not names:
+        return
+    seen: Dict[str, int] = {}
+    for n in names:
+        if n is not None:
+            seen[n] = seen.get(n, 0) + 1
+    for n, count in seen.items():
+        sess = _SESSIONS.get(n)
+        if sess is not None:
+            sess.stats[field] += count if per_root else 1
+
+
+def _on_note(kind: str, **data) -> None:
+    """fusion's ``_SERVING_NOTE`` seam: per-session billing + incident
+    containment. Called under fusion's force lock; must never raise."""
+    try:
+        if kind == "dispatch":
+            sessions = data.get("sessions")
+            _bill(sessions, "dispatches")
+            _bill(sessions, "roots", per_root=True)
+            trigger = data.get("trigger")
+            if data.get("compiled") and trigger is not None:
+                sess = _SESSIONS.get(trigger)
+                if sess is not None:
+                    sess.stats["compiles"] += 1
+            return
+        if kind == "degraded":
+            sess = _current_session()
+            if sess is not None:
+                sess.stats["degraded"] += 1
+                sess._incident(kind, data)
+            return
+        if kind == "quarantine_hit":
+            names = [n for n in (data.get("sessions") or ()) if n is not None]
+            if not names and _current_session() is not None:
+                names = [_current_session().name]
+            for n in dict.fromkeys(names):
+                sess = _SESSIONS.get(n)
+                if sess is not None:
+                    sess.stats["quarantine_hits"] += 1
+                    sess._incident(kind, data)
+            return
+        if kind == "mem_refused":
+            sess = _current_session()
+            if sess is not None:
+                sess.stats["mem_refused"] += 1
+                sess._incident(kind, data)
+    except Exception:  # pragma: no cover - billing never breaks a dispatch
+        pass
+
+
+def _admit(program: str, cid, n_roots: int) -> None:
+    """fusion's ``_ADMIT_HOOK`` seam: the token-bucket gate, composed
+    before memledger's headroom gate at the same pre-dispatch point. The
+    session's own bucket is consulted first (cheap containment), then the
+    global one; a raise-refusal refunds the session token so the retry is
+    not double-charged. Under ``wait`` the force blocks until refill —
+    the chain stays pending the whole time, mirroring ``admission_hold``."""
+    sess = _current_session()
+    buckets: List[_TokenBucket] = []
+    if sess is not None and sess.bucket is not None:
+        buckets.append(sess.bucket)
+    if _GLOBAL_BUCKET is not None:
+        buckets.append(_GLOBAL_BUCKET)
+    if not buckets:
+        return
+    policy = sess.policy if sess is not None and sess.policy else _POLICY
+    taken: List[_TokenBucket] = []
+    for bucket in buckets:
+        while True:
+            wait = bucket.take()
+            if wait <= 0.0:
+                taken.append(bucket)
+                break
+            if policy == "raise":
+                bucket.refused += 1
+                for t in taken:  # refund earlier buckets in the chain
+                    t.give_back()
+                if sess is not None:
+                    sess.stats["admission_refused"] += 1
+                    sess._incident("admission_refused",
+                                   {"bucket": bucket.name, "program": program})
+                raise AdmissionError(
+                    f"dispatch of program {program} refused by the "
+                    f"{bucket.name} admission bucket for session "
+                    f"{sess.name if sess is not None else '<none>'} "
+                    f"(rate {bucket.rate}/s, burst {int(bucket.burst)}; "
+                    f"retry in {wait:.3f}s or use the 'wait' policy) — the "
+                    "chain is still pending and dispatches once tokens refill"
+                )
+            # wait policy: the refused chain stays pending and dispatches
+            # when tokens refill (nothing degraded, nothing re-walked)
+            bucket.waited_s += wait
+            if sess is not None:
+                sess.stats["admission_waits"] += 1
+                sess.stats["admission_waited_s"] += wait
+            if telemetry._MODE >= 2:
+                telemetry.record_event(
+                    "admission_wait", bucket=bucket.name, program=program,
+                    seconds=round(wait, 6),
+                )
+            time.sleep(wait)
+
+
+def _install_hooks() -> None:
+    fusion._SERVING_NOTE = _on_note
+    fusion._SESSION_OF = _current_session_name
+    _refresh_admit_hook()
+
+
+def _uninstall_hooks() -> None:
+    fusion._SERVING_NOTE = None
+    fusion._SESSION_OF = None
+    _refresh_admit_hook()
+
+
+def _refresh_admit_hook() -> None:
+    """The admit hook is live whenever any bucket could gate a dispatch:
+    a global env/set_admission bucket, or an active session with its own."""
+    armed = _GLOBAL_BUCKET is not None
+    if not armed:
+        with _LOCK:
+            armed = any(
+                s.bucket is not None and s._entered > 0 for s in _SESSIONS.values()
+            )
+    fusion._ADMIT_HOOK = _admit if armed else None
+
+
+#: cross-session micro batch window (seconds). Armed on ``fusion`` whenever
+#: >= 2 sessions are concurrently active: each top-level force sleeps this
+#: long with the GIL released before dispatching, so the other tenants'
+#: threads get to register their pending roots and ride the SAME multi-output
+#: program — the thing that keeps N-client steady-state p99 flat instead of
+#: convoying N serialized dispatches behind the force lock.
+_BATCH_WINDOW = 5e-4
+
+
+def _refresh_batch_window() -> None:
+    fusion._BATCH_WINDOW_S = _BATCH_WINDOW if _ACTIVE >= 2 else 0.0
+
+
+# ----------------------------------------------------------------------
+# Session
+# ----------------------------------------------------------------------
+class Session:
+    """One tenant on the warm mesh, used as a context manager on the
+    client's thread::
+
+        with ht.serving.Session("tenant-a", errstate="raise") as sess:
+            ...  # every chain recorded here is billed to tenant-a
+
+    Inside the ``with`` block, the calling thread gets: a telemetry scope
+    ``session:<name>`` (isolated counters/spans + scoped latency
+    histograms), the session's numeric error policy (``errstate`` of
+    ``"ignore"``/``"warn"``/``"raise"``; ``None`` inherits the global
+    ``ht.errstate``), an isolated numerics-lens sampling frame (``numlens``
+    of ``"off"``/``"sample"``/``"full"``; ``None`` inherits the global
+    mode but still samples on its own cadence and counters), and — when an
+    admission rate is configured — the session's own token bucket composed
+    with the global one. Incidents (degraded programs, quarantine hits,
+    memory-gate and admission refusals) are recorded on THIS session only:
+    a tenant tripping a gate is contained and reported per-session, never
+    poisoning neighbors. Thread-safe: distinct threads can run distinct
+    sessions concurrently (state is thread-local), and one Session object
+    may be entered from several threads at once (each gets its own scope
+    entry; the stats roll up)."""
+
+    def __init__(self, name: Optional[str] = None, *,
+                 errstate: Optional[str] = None,
+                 numlens: Optional[str] = None,
+                 admission_rate: Optional[float] = None,
+                 admission_burst: Optional[float] = None,
+                 policy: Optional[str] = None):
+        self.name = name if name else f"session{next(_SESSION_SEQ)}"
+        if errstate is not None and errstate not in ("ignore", "warn", "raise"):
+            raise ValueError(
+                f"errstate must be one of ('ignore', 'warn', 'raise'), got {errstate!r}"
+            )
+        if policy is not None and policy not in _POLICIES:
+            raise ValueError(f"policy must be one of {_POLICIES}, got {policy!r}")
+        self._errstate = errstate
+        self._numlens = numlens
+        self.policy = policy
+        rate = admission_rate if admission_rate is not None else _ENV_RATE
+        if rate is not None:
+            burst = admission_burst if admission_burst is not None else \
+                max(_ENV_BURST, 1.0)
+            self.bucket: Optional[_TokenBucket] = _TokenBucket(
+                rate, burst, f"session:{self.name}"
+            )
+        else:
+            self.bucket = None
+        self.stats: Dict[str, Any] = {
+            "dispatches": 0,
+            "roots": 0,
+            "compiles": 0,
+            "degraded": 0,
+            "quarantine_hits": 0,
+            "mem_refused": 0,
+            "admission_refused": 0,
+            "admission_waits": 0,
+            "admission_waited_s": 0.0,
+        }
+        self.incidents: deque = deque(maxlen=64)
+        self._entered = 0  # concurrent __enter__ count, across threads
+        self._sess_tls = threading.local()  # per-thread enter bookkeeping
+
+    # -- lifecycle ------------------------------------------------------
+    def __enter__(self) -> "Session":
+        global _ACTIVE
+        with _LOCK:
+            registered = _SESSIONS.get(self.name)
+            if (registered is not None and registered is not self
+                    and registered._entered > 0):
+                raise ValueError(
+                    f"a Session named {self.name!r} is already ACTIVE (names "
+                    "are the billing key — two live tenants must not share "
+                    "one); an exited session's name is reusable"
+                )
+            _SESSIONS[self.name] = self  # reusing a name rolls the archive over
+            self._entered += 1
+            _ACTIVE += 1
+        if _ACTIVE == 1 or fusion._SERVING_NOTE is None:
+            _install_hooks()
+        elif self.bucket is not None:
+            _refresh_admit_hook()
+        _refresh_batch_window()
+        frames = getattr(self._sess_tls, "frames", None)
+        if frames is None:
+            frames = self._sess_tls.frames = []
+        scope_cm = telemetry.scope(f"session:{self.name}")
+        scope_cm.__enter__()
+        if self._errstate is not None:
+            resilience._push_errstate(
+                None if self._errstate == "ignore" else self._errstate
+            )
+        numlens._push_session(self._numlens)
+        _session_stack().append(self)
+        frames.append(scope_cm)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _ACTIVE
+        stack = _session_stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                break
+        numlens._pop_session()
+        if self._errstate is not None:
+            resilience._pop_errstate()
+        frames = getattr(self._sess_tls, "frames", None)
+        if frames:
+            frames.pop().__exit__(*exc)
+        with _LOCK:
+            self._entered -= 1
+            _ACTIVE -= 1
+            last = _ACTIVE == 0
+        if last:
+            _uninstall_hooks()
+        elif self.bucket is not None:
+            _refresh_admit_hook()
+        _refresh_batch_window()
+
+    # -- reporting ------------------------------------------------------
+    def _incident(self, kind: str, data: Dict[str, Any]) -> None:
+        rec = {"kind": kind}
+        rec.update({k: v for k, v in data.items() if k != "sessions"})
+        self.incidents.append(rec)
+
+    def quarantined_programs(self) -> List[str]:
+        """Program keys THIS session saw degrade or hit quarantine — the
+        per-session quarantine view (the global ledger is in
+        ``fusion.cache_stats()``)."""
+        keys = []
+        for rec in self.incidents:
+            if rec["kind"] in ("degraded", "quarantine_hit"):
+                key = rec.get("program")
+                if key and key not in keys:
+                    keys.append(key)
+        return keys
+
+    def report(self) -> Dict[str, Any]:
+        """This session's block: billing counters, incidents, quarantine
+        view and bucket stats. Pure module state — never forces, never
+        initializes a backend."""
+        doc: Dict[str, Any] = {
+            "name": self.name,
+            "active": self._entered > 0,
+            "errstate": self._errstate or "inherit",
+            "numlens": self._numlens or "inherit",
+            "stats": dict(self.stats),
+            "incidents": list(self.incidents),
+            "quarantine": self.quarantined_programs(),
+        }
+        if self.bucket is not None:
+            doc["bucket"] = self.bucket.stats()
+        return doc
+
+
+# ----------------------------------------------------------------------
+# the persistent cache: arming + warmup
+# ----------------------------------------------------------------------
+def arm_cache(path: str) -> Dict[str, Any]:
+    """Arm the persistent program cache at ``path`` (the programmatic form
+    of ``HEAT_TPU_PROGRAM_CACHE_DIR``): wire jax's compilation cache to
+    ``<path>/xla`` (best-effort — accounting works even where the backend
+    does not persist binaries) and load the program-key index from
+    ``<path>/programs.jsonl``. Returns ``{"dir", "index_keys", "skipped"}``."""
+    global _CACHE_DIR, _INDEX, _XLA_CACHE_WIRED, _XLA_PREV_CONFIG
+    os.makedirs(path, exist_ok=True)
+    if not _XLA_CACHE_WIRED:
+        try:
+            import jax
+
+            _XLA_PREV_CONFIG = (
+                jax.config.jax_compilation_cache_dir,
+                jax.config.jax_persistent_cache_min_compile_time_secs,
+                jax.config.jax_persistent_cache_min_entry_size_bytes,
+            )
+            jax.config.update("jax_compilation_cache_dir",
+                              os.path.join(path, "xla"))
+            # tiny serving programs must cache too: drop the default
+            # minimum-compile-time and minimum-entry-size thresholds
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+            _XLA_CACHE_WIRED = True
+        except Exception as exc:  # pragma: no cover - backend-dependent
+            warnings.warn(
+                f"could not wire jax's compilation cache ({exc!r}); the "
+                "program-key index still arms (disk hits are counted, the "
+                "backend just recompiles)",
+                stacklevel=2,
+            )
+    _CACHE_DIR = path
+    _INDEX = _DiskIndex(os.path.join(path, "programs.jsonl"))
+    _INDEX.load()
+    fusion._DISK_INDEX = _INDEX
+    return {"dir": path, "index_keys": len(_INDEX.keys), "skipped": _INDEX.skipped}
+
+
+def disarm_cache() -> None:
+    """Detach the persistent index and restore jax's compilation-cache
+    config — leaving it pointed at a caller-owned (possibly deleted) dir
+    would make every later compile warn about failed cache writes."""
+    global _CACHE_DIR, _INDEX, _XLA_CACHE_WIRED, _XLA_PREV_CONFIG
+    _CACHE_DIR = None
+    _INDEX = None
+    fusion._DISK_INDEX = None
+    if _XLA_CACHE_WIRED and _XLA_PREV_CONFIG is not None:
+        try:
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", _XLA_PREV_CONFIG[0])
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", _XLA_PREV_CONFIG[1]
+            )
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", _XLA_PREV_CONFIG[2]
+            )
+        except Exception:  # pragma: no cover - backend-dependent
+            pass
+        _XLA_CACHE_WIRED = False
+        _XLA_PREV_CONFIG = None
+
+
+def warmup(signatures) -> Dict[str, int]:
+    """Pre-bake the program cache ahead of traffic. Each item is either a
+    zero-arg callable recording one representative chain (its result is
+    forced — compiling, or disk-loading when the signature was seen by an
+    earlier process) or a bare program-key string to seed the persistent
+    index directly. Returns how the warming went::
+
+        {"warmed": n, "compiles": Δ, "disk_hits": Δ, "seeded": k}
+    """
+    before = fusion.cache_stats()
+    warmed = seeded = 0
+    for item in signatures:
+        if isinstance(item, str):
+            if _INDEX is not None:
+                _INDEX.note(item, "?")
+                seeded += 1
+            continue
+        result = item()
+        for out in result if isinstance(result, (tuple, list)) else (result,):
+            payload = getattr(out, "_payload", out)
+            forced = fusion.force(payload)
+            ready = getattr(forced, "block_until_ready", None)
+            if ready is not None:
+                ready()
+        warmed += 1
+    after = fusion.cache_stats()
+    return {
+        "warmed": warmed,
+        "seeded": seeded,
+        "compiles": after["compiles"] - before["compiles"],
+        "disk_hits": after["disk_hits"] - before["disk_hits"],
+    }
+
+
+def cache_stats() -> Dict[str, Any]:
+    """``fusion.cache_stats()`` plus the persistent layer: where the cache
+    dir is (or None disarmed), how many keys the index holds, and how many
+    corrupt lines were skipped loading it."""
+    st = fusion.cache_stats()
+    st["persistent_dir"] = _CACHE_DIR
+    st["index_keys"] = 0 if _INDEX is None else len(_INDEX.keys)
+    st["index_skipped"] = 0 if _INDEX is None else _INDEX.skipped
+    return st
+
+
+# ----------------------------------------------------------------------
+# admission configuration
+# ----------------------------------------------------------------------
+def set_admission(rate: Optional[float], burst: Optional[float] = None,
+                  policy: Optional[str] = None) -> None:
+    """Arm (or, with ``rate=None``, disarm) the GLOBAL admission bucket —
+    the programmatic form of ``HEAT_TPU_ADMISSION_RATE``/``_BURST``/
+    ``_POLICY``. Per-session buckets are per-:class:`Session` kwargs."""
+    global _GLOBAL_BUCKET, _POLICY
+    if policy is not None:
+        if policy not in _POLICIES:
+            raise ValueError(f"policy must be one of {_POLICIES}, got {policy!r}")
+        _POLICY = policy
+    if rate is None:
+        _GLOBAL_BUCKET = None
+    else:
+        if rate <= 0:
+            raise ValueError(f"rate must be > 0 tokens/second, got {rate}")
+        _GLOBAL_BUCKET = _TokenBucket(
+            rate, burst if burst is not None else max(rate, 1.0), "global"
+        )
+    _refresh_admit_hook()
+
+
+# ----------------------------------------------------------------------
+# report surfaces
+# ----------------------------------------------------------------------
+def session_reports() -> List[Dict[str, Any]]:
+    """Every session's report block (active and exited), entry order."""
+    with _LOCK:
+        sessions = list(_SESSIONS.values())
+    return [s.report() for s in sessions]
+
+
+def sessions_block() -> Dict[str, Any]:
+    """The ``report()["serving"]`` payload: per-session blocks, the global
+    admission bucket, and the persistent-cache summary. Pure module state —
+    never forces, never initializes a backend."""
+    with _LOCK:
+        sessions = list(_SESSIONS.values())
+    return {
+        "sessions": [s.report() for s in sessions],
+        "active": sum(1 for s in sessions if s._entered > 0),
+        "admission": {
+            "policy": _POLICY,
+            "global": None if _GLOBAL_BUCKET is None else _GLOBAL_BUCKET.stats(),
+        },
+        "cache": {
+            "persistent_dir": _CACHE_DIR,
+            "index_keys": 0 if _INDEX is None else len(_INDEX.keys),
+            "disk_hits": fusion._STATS["disk_hits"],
+        },
+    }
+
+
+def reset() -> None:
+    """Forget exited sessions and zero the global bucket's counters (active
+    sessions and the arming itself — cache dir, rates — are configuration
+    and survive, mirroring ``memledger.reset``). Called from
+    ``telemetry.reset()`` so the joined report surfaces clear together."""
+    with _LOCK:
+        for name in [n for n, s in _SESSIONS.items() if s._entered == 0]:
+            del _SESSIONS[name]
+    _refresh_batch_window()
+    if _GLOBAL_BUCKET is not None:
+        with _GLOBAL_BUCKET._lock:
+            _GLOBAL_BUCKET.admitted = 0
+            _GLOBAL_BUCKET.refused = 0
+            _GLOBAL_BUCKET.waited_s = 0.0
+
+
+# ----------------------------------------------------------------------
+# import-time arming from the env knobs
+# ----------------------------------------------------------------------
+_env_cache_dir = _parse_env_cache_dir()
+if _env_cache_dir is not None:
+    arm_cache(_env_cache_dir)
+if _ENV_RATE is not None:
+    _GLOBAL_BUCKET = _TokenBucket(_ENV_RATE, _ENV_BURST, "global")
+    _refresh_admit_hook()

@@ -303,10 +303,12 @@ def enabled(mode=1):
 class _State:
     """One isolated set of telemetry counters + an event deque.
 
-    The module keeps a stack of these: ``_STATES[0]`` is the global state
-    and every active :func:`scope` pushes its own. Record functions write to
-    EVERY state on the stack (so scopes roll up live); query functions read
-    the INNERMOST (so scopes are isolated)."""
+    The module keeps one shared global state (``_GLOBAL``) plus a
+    THREAD-LOCAL stack of scope states: every active :func:`scope` pushes
+    its own onto the entering thread's stack. Record functions write to the
+    global state and every scope on the calling thread's stack (so scopes
+    roll up live); query functions read the calling thread's INNERMOST
+    scope (so concurrent sessions are isolated)."""
 
     __slots__ = (
         "path", "t0", "wall_s", "calls", "collectives", "forces", "retraces",
@@ -436,19 +438,58 @@ def _merge_state(dst: _State, src: _State) -> None:
 
 
 _GLOBAL = _State()
-#: every state currently recording: the global one + the active scope stack
-_STATES: List[_State] = [_GLOBAL]
-#: active scopes only (innermost last)
-_SCOPE_STACK: List[_State] = []
 #: completed-scope accumulators, keyed by scope path (re-entry accumulates)
 _SCOPES: Dict[str, _State] = {}
 
-_TRIGGER_STACK: List[str] = []
-_SPAN_STACK: list = []
+# Scope/span/trigger stacks are THREAD-LOCAL: each thread (a serving
+# session, a client of `ht.serving`) resolves its own innermost scope, so a
+# second thread entering a scope can never interleave with the first's
+# stack. Records still roll up into the shared _GLOBAL state, queries read
+# the calling thread's innermost scope, and the completed-scope archive is
+# merged under _SCOPE_LOCK.
+_TLS = threading.local()
+#: the common fast path (no scope active on this thread) — one cached tuple,
+#: no per-record allocation
+_GLOBAL_ONLY = (_GLOBAL,)
+#: every scope state currently active on ANY thread (reset() must clear all)
+_ACTIVE_SCOPE_STATES: List[_State] = []
+_SCOPE_LOCK = threading.Lock()
+
+
+def _scope_stack() -> List[_State]:
+    """This thread's active scope states, innermost last (created lazily)."""
+    stack = getattr(_TLS, "scopes", None)
+    if stack is None:
+        stack = _TLS.scopes = []
+    return stack
+
+
+def _states():
+    """Every state the calling thread records into: the shared global state
+    plus this thread's own scope stack."""
+    stack = getattr(_TLS, "scopes", None)
+    if not stack:
+        return _GLOBAL_ONLY
+    return [_GLOBAL] + stack
+
+
+def _span_stack() -> list:
+    stack = getattr(_TLS, "spans", None)
+    if stack is None:
+        stack = _TLS.spans = []
+    return stack
+
+
+def _trigger_stack() -> List[str]:
+    stack = getattr(_TLS, "triggers", None)
+    if stack is None:
+        stack = _TLS.triggers = []
+    return stack
 
 
 def _cur() -> _State:
-    return _STATES[-1]
+    stack = getattr(_TLS, "scopes", None)
+    return stack[-1] if stack else _GLOBAL
 
 
 def reset() -> None:
@@ -466,9 +507,11 @@ def reset() -> None:
     :func:`scope`/:func:`span` stacks keep recording."""
     global _DROP_WARNED
     _DROP_WARNED = False
-    for st in _STATES:
-        st.clear()
-    _SCOPES.clear()
+    _GLOBAL.clear()
+    with _SCOPE_LOCK:
+        for st in list(_ACTIVE_SCOPE_STATES):  # every thread's active scopes
+            st.clear()
+        _SCOPES.clear()
     try:
         from ..utils import profiling
 
@@ -499,6 +542,12 @@ def reset() -> None:
         numlens.reset()
     except Exception:  # pragma: no cover - import-order safety only
         pass
+    try:
+        from . import serving
+
+        serving.reset()
+    except Exception:  # pragma: no cover - import-order safety only
+        pass
 
 
 # ----------------------------------------------------------------------
@@ -511,9 +560,10 @@ def _emit(kind: str, **fields) -> dict:
     scope path when a scope is active."""
     ev: Dict[str, Any] = {"kind": kind, "ts": time.perf_counter()}
     ev.update(fields)
-    if _SCOPE_STACK:
-        ev["scope"] = _SCOPE_STACK[-1].path
-    for st in _STATES:
+    stack = getattr(_TLS, "scopes", None)
+    if stack:
+        ev["scope"] = stack[-1].path
+    for st in _states():
         st.append_event(ev)
     return ev
 
@@ -532,8 +582,9 @@ def _note_event(kind: str, **fields) -> Optional[dict]:
     if _MODE and _FLIGHT_HOOK is not None:
         ev = {"kind": kind, "ts": time.perf_counter()}
         ev.update(fields)
-        if _SCOPE_STACK:
-            ev["scope"] = _SCOPE_STACK[-1].path
+        stack = getattr(_TLS, "scopes", None)
+        if stack:
+            ev["scope"] = stack[-1].path
         _FLIGHT_HOOK(ev)
         return ev
     return None
@@ -570,15 +621,20 @@ def scope(name: str):
     server can meter one session without losing the fleet-wide picture.
     Scopes are reentrant and nest (paths join as ``outer/inner``); on exit
     the session is archived under ``report()["scopes"][path]``, re-entering
-    the same path accumulates (``calls`` counts entries). Yields the scope
+    the same path accumulates (``calls`` counts entries). The stack is
+    THREAD-LOCAL: concurrent threads each resolve their own innermost scope
+    (two threads entering scopes never interleave stacks), while the global
+    rollup and the completed-scope archive stay shared. Yields the scope
     path, or None when telemetry is off."""
     if not _MODE:
         yield None
         return
-    path = (_SCOPE_STACK[-1].path + "/" + str(name)) if _SCOPE_STACK else str(name)
+    stack = _scope_stack()
+    path = (stack[-1].path + "/" + str(name)) if stack else str(name)
     st = _State(path)
-    _SCOPE_STACK.append(st)
-    _STATES.append(st)
+    stack.append(st)
+    with _SCOPE_LOCK:
+        _ACTIVE_SCOPE_STATES.append(st)
     try:  # the health layer scopes its histograms alongside (joined surface)
         from . import health_runtime
 
@@ -590,17 +646,21 @@ def scope(name: str):
     finally:
         st.wall_s = time.perf_counter() - st.t0
         # remove by identity: reset()/nesting must never pop the wrong frame
-        for lst in (_STATES, _SCOPE_STACK):
-            for i in range(len(lst) - 1, -1, -1):
-                if lst[i] is st:
-                    del lst[i]
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is st:
+                del stack[i]
+                break
+        with _SCOPE_LOCK:
+            for i in range(len(_ACTIVE_SCOPE_STATES) - 1, -1, -1):
+                if _ACTIVE_SCOPE_STATES[i] is st:
+                    del _ACTIVE_SCOPE_STATES[i]
                     break
-        acc = _SCOPES.get(path)
-        if acc is None:
-            acc = _SCOPES[path] = _State(path)
-            acc.calls = 0
-            acc.wall_s = 0.0
-        _merge_state(acc, st)
+            acc = _SCOPES.get(path)
+            if acc is None:
+                acc = _SCOPES[path] = _State(path)
+                acc.calls = 0
+                acc.wall_s = 0.0
+            _merge_state(acc, st)
         try:
             from . import health_runtime
 
@@ -742,7 +802,7 @@ def record_collective(
     if not _MODE:
         return
     _maybe_host_delay()
-    for st in _STATES:
+    for st in _states():
         rec = st.collectives.get(op)
         if rec is None:
             rec = st.collectives[op] = {"count": 0, "bytes": 0, "axes": {}, "dtypes": {}}
@@ -758,8 +818,7 @@ def record_collective(
             op=op, axis=axis, bytes=int(nbytes), dtype=dtype, count=count,
             traced=_in_trace(),
         )
-    if _SPAN_STACK:
-        for frame in _SPAN_STACK:
+    for frame in _span_stack():
             frame.collectives[op] = frame.collectives.get(op, 0) + count
     if _MEM_HOOK is not None:
         _MEM_HOOK("collective")
@@ -804,7 +863,7 @@ def record_fused_collective(
     if not _MODE:
         return
     _maybe_host_delay()
-    for st in _STATES:
+    for st in _states():
         st.fused_collectives[kind] = st.fused_collectives.get(kind, 0) + 1
     _note_event("fused_collective", op=kind, cid=cid, detail=detail)
 
@@ -822,21 +881,31 @@ def record_async_dispatch(
     cid: Optional[int] = None,
     cids=(),
     program: Optional[str] = None,
+    sessions=None,
 ) -> None:
     """Count one asynchronous ``fusion.force`` dispatch covering ``n_roots``
     DAG roots (>1 = independent live roots batched into one multi-output
     program). Dispatches install device futures without blocking. ``cid`` is
     the triggering chain's correlation id, ``cids`` every batched root's,
     ``program`` the sharded-program key launched (None for degraded/
-    quarantined replays) — the timeline event links the whole lifecycle."""
+    quarantined replays) — the timeline event links the whole lifecycle.
+    ``sessions`` (aligned with ``cids``) names each batched root's serving
+    session when cross-session batching grouped tenants into one dispatch,
+    so tracelens/SLO attribution can bill the right tenant per cid."""
     if not _MODE:
         return
-    for st in _STATES:
+    for st in _states():
         st.async_["dispatches"] += 1
         st.async_["roots"] += int(n_roots)
         if n_roots > 1:
             st.async_["multi_root_batches"] += 1
-    _note_event("dispatch", roots=int(n_roots), cid=cid, cids=list(cids), program=program)
+    if sessions is not None and any(s is not None for s in sessions):
+        _note_event(
+            "dispatch", roots=int(n_roots), cid=cid, cids=list(cids),
+            program=program, sessions=list(sessions),
+        )
+    else:
+        _note_event("dispatch", roots=int(n_roots), cid=cid, cids=list(cids), program=program)
     if _MEM_HOOK is not None:
         _MEM_HOOK("dispatch")
 
@@ -856,7 +925,7 @@ def record_blocking_sync(kind: str, cid: Optional[int] = None) -> Optional[dict]
     "how long did we wait")."""
     if not _MODE:
         return None
-    for st in _STATES:
+    for st in _states():
         st.blocking[kind] = st.blocking.get(kind, 0) + 1
     ev = _note_event("blocking_sync", where=kind, cid=cid)
     if ev is not None:
@@ -877,7 +946,7 @@ def end_blocking_sync(token: Optional[dict]) -> None:
     dur = time.perf_counter() - token["ts"]
     token["dur"] = dur
     kind = str(token.get("where"))
-    for st in _STATES:
+    for st in _states():
         rec = st.sync_wait.get(kind)
         if rec is None:
             rec = st.sync_wait[kind] = {"count": 0, "total_s": 0.0, "max_s": 0.0}
@@ -929,11 +998,11 @@ class _TriggerScope:
         self.name = name
 
     def __enter__(self) -> "_TriggerScope":
-        _TRIGGER_STACK.append(self.name)
+        _trigger_stack().append(self.name)
         return self
 
     def __exit__(self, *exc) -> None:
-        _TRIGGER_STACK.pop()
+        _trigger_stack().pop()
 
 
 _TRIGGER_SCOPES: Dict[str, _TriggerScope] = {}
@@ -950,7 +1019,8 @@ def force_trigger(name: str) -> _TriggerScope:
 def current_trigger() -> str:
     """The attribution for a force firing right now (outermost scope, or the
     bare-``parray``-access default)."""
-    return _TRIGGER_STACK[0] if _TRIGGER_STACK else "parray"
+    stack = getattr(_TLS, "triggers", None)
+    return stack[0] if stack else "parray"
 
 
 def record_force(trigger: str, depth: int, compiled: bool = False, cid: Optional[int] = None) -> None:
@@ -960,7 +1030,7 @@ def record_force(trigger: str, depth: int, compiled: bool = False, cid: Optional
     correlation id."""
     if not _MODE:
         return
-    for st in _STATES:
+    for st in _states():
         rec = st.forces.get(trigger)
         if rec is None:
             rec = st.forces[trigger] = {"count": 0, "depth_total": 0, "max_depth": 0, "compiles": 0}
@@ -971,8 +1041,7 @@ def record_force(trigger: str, depth: int, compiled: bool = False, cid: Optional
         if compiled:
             rec["compiles"] += 1
     _note_event("force", trigger=trigger, depth=int(depth), compiled=compiled, cid=cid)
-    if _SPAN_STACK:
-        for frame in _SPAN_STACK:
+    for frame in _span_stack():
             frame.forces += 1
     if _MEM_HOOK is not None:
         _MEM_HOOK("force")
@@ -1009,7 +1078,7 @@ def record_retrace(family: tuple, shape_key) -> None:
         return
     grec0 = _GLOBAL.retraces.get(family)
     already_warned = grec0 is not None and grec0["warned"]
-    for st in _STATES:
+    for st in _states():
         rec = st.retraces.get(family)
         if rec is None:
             # a family the GLOBAL ledger already warned on starts warned in
@@ -1022,14 +1091,13 @@ def record_retrace(family: tuple, shape_key) -> None:
             # ``misses`` tracks volume and the set stops growing (shape churn is
             # exactly the case that would otherwise accumulate keys unboundedly)
             rec["keys"].add(shape_key)
-    if _SPAN_STACK:
-        for frame in _SPAN_STACK:
+    for frame in _span_stack():
             frame.retraces += 1
     grec = _GLOBAL.retraces.get(family)
     if grec is None:  # reset() raced the loop above; nothing to warn on
         return
     if not grec["warned"] and len(grec["keys"]) >= _RETRACE_WARN_AFTER:
-        for st in _STATES:
+        for st in _states():
             rec = st.retraces.get(family)
             if rec is not None:
                 rec["warned"] = True
@@ -1066,7 +1134,7 @@ def record_compile(label: str, cid: Optional[int] = None) -> None:
     ``MeshCommunication.apply`` kernel), keyed by kernel label."""
     if not _MODE:
         return
-    for st in _STATES:
+    for st in _states():
         st.compiles[label] = st.compiles.get(label, 0) + 1
     _note_event("compile", label=label, cid=cid)
 
@@ -1080,7 +1148,7 @@ def record_dispatch(engine: str, fused: bool) -> None:
     if not _MODE:
         return
     key = "fused" if fused else "eager"
-    for st in _STATES:
+    for st in _states():
         rec = st.dispatches.get(engine)
         if rec is None:
             rec = st.dispatches[engine] = {"fused": 0, "eager": 0}
@@ -1099,7 +1167,7 @@ def record_unfused(engine: str, reason: str) -> None:
     shows *why* a chain wasn't fused, not just that it wasn't."""
     if not _MODE:
         return
-    for st in _STATES:
+    for st in _states():
         rec = st.unfused.get(engine)
         if rec is None:
             rec = st.unfused[engine] = {}
@@ -1122,7 +1190,7 @@ def record_degraded(family: tuple, stage: str, error: str = "") -> None:
     if not _MODE:
         return
     key = "/".join(family) or "<leaf>"
-    for st in _STATES:
+    for st in _states():
         rec = st.degraded.get(key)
         if rec is None:
             rec = st.degraded[key] = {"count": 0, "stages": {}, "last_error": ""}
@@ -1161,7 +1229,7 @@ def record_fault(site: str, pattern: str = "") -> None:
     degradation/retry activity right next to the fault that caused it."""
     if not _MODE:
         return
-    for st in _STATES:
+    for st in _states():
         st.faults[site] = st.faults.get(site, 0) + 1
     _note_event("fault", site=site, pattern=pattern)
 
@@ -1176,7 +1244,7 @@ def record_nonfinite(where: str) -> None:
     """Count one errstate non-finite detection at forcing point ``where``."""
     if not _MODE:
         return
-    for st in _STATES:
+    for st in _states():
         st.nonfinite[where] = st.nonfinite.get(where, 0) + 1
     _note_event("nonfinite", where=where)
 
@@ -1190,7 +1258,7 @@ def record_io_retry(site: str) -> None:
     """Count one transient-``OSError`` retry at I/O injection site ``site``."""
     if not _MODE:
         return
-    for st in _STATES:
+    for st in _states():
         st.io_retries[site] = st.io_retries.get(site, 0) + 1
     _note_event("io_retry", site=site)
 
@@ -1211,7 +1279,7 @@ def record_checkpoint(event: str, step: Optional[int] = None, detail: str = "") 
     these counts."""
     if not _MODE:
         return
-    for st in _STATES:
+    for st in _states():
         st.checkpoint[event] = st.checkpoint.get(event, 0) + 1
     _note_event("checkpoint", event=event, step=step, detail=detail)
     if _MEM_HOOK is not None:
@@ -1252,19 +1320,20 @@ def span(name: str):
     if not _MODE:
         yield None
         return
-    path = (_SPAN_STACK[-1].path + "/" + name) if _SPAN_STACK else name
+    spans = _span_stack()
+    path = (spans[-1].path + "/" + name) if spans else name
     frame = _SpanFrame(path)
-    _SPAN_STACK.append(frame)
+    spans.append(frame)
     if _MODE >= 2:
         _emit("span_begin", name=path)
     try:
         yield path
     finally:
-        _SPAN_STACK.pop()
+        spans.pop()
         elapsed = time.perf_counter() - frame.t0
         if _MODE >= 2:
             _emit("span_end", name=path, dur=elapsed)
-        for st in _STATES:
+        for st in _states():
             rec = st.spans.get(path)
             if rec is None:
                 rec = st.spans[path] = {
@@ -1301,7 +1370,7 @@ def on_timer(name: str, elapsed: float) -> None:
         return
     if _MODE >= 2:
         _emit("timer", name=name, dur=elapsed)
-    for frame in _SPAN_STACK:
+    for frame in _span_stack():
         frame.timers[name] = frame.timers.get(name, 0.0) + elapsed
 
 
@@ -1480,6 +1549,13 @@ def report(*, _state: Optional[_State] = None) -> Dict[str, Any]:
 
         doc["timers"] = profiling.report()
     except Exception:  # pragma: no cover
+        pass
+    try:
+        from . import serving
+
+        if serving._SESSIONS:  # only when the serving layer has sessions
+            doc["serving"] = serving.sessions_block()
+    except Exception:  # pragma: no cover - the report never fails
         pass
     if _ELASTIC_HOOK is not None:
         try:

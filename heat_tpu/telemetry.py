@@ -388,6 +388,74 @@ def _diff(a: Dict[str, Any], b: Dict[str, Any], out, top: int = 40) -> int:
 # ----------------------------------------------------------------------
 # numerics: tensor stats + drift ledger + SDC canary + training streams
 # ----------------------------------------------------------------------
+def _sessions_doc(report_path: Optional[str]) -> Dict[str, Any]:
+    """The serving picture to render: a saved report's ``serving`` block
+    when a path is given, else THIS process's live block — pure module
+    state, no mesh bring-up (the same never-initialize contract as
+    ``health``/``numerics``)."""
+    if report_path is not None:
+        doc = _load(report_path)
+        return {"source": report_path, "serving": doc.get("serving") or {}}
+    from heat_tpu.core import serving
+
+    return {"source": "<live>", "serving": serving.sessions_block()}
+
+
+def _show_sessions(doc: Dict[str, Any], out) -> None:
+    blk = doc.get("serving") or {}
+    print(f"serving ({doc.get('source', '?')}):", file=out)
+    sessions = blk.get("sessions") or []
+    if not sessions:
+        print("  no sessions recorded", file=out)
+    adm = blk.get("admission") or {}
+    gbl = adm.get("global")
+    if gbl:
+        print(
+            f"  admission: policy {adm.get('policy', 'wait')}, global bucket "
+            f"{gbl.get('rate')}/s burst {gbl.get('burst')} — "
+            f"{gbl.get('admitted', 0)} admitted, {gbl.get('refused', 0)} "
+            f"refused, {gbl.get('waited_s', 0)}s waited",
+            file=out,
+        )
+    cache = blk.get("cache") or {}
+    if cache.get("persistent_dir"):
+        print(
+            f"  persistent cache: {cache['persistent_dir']} "
+            f"({cache.get('index_keys', 0)} indexed keys, "
+            f"{cache.get('disk_hits', 0)} disk hits)",
+            file=out,
+        )
+    for sess in sessions:
+        st = sess.get("stats") or {}
+        state = "active" if sess.get("active") else "exited"
+        print(
+            f"  {sess.get('name', '?')} ({state}): "
+            f"{st.get('dispatches', 0)} dispatches "
+            f"({st.get('roots', 0)} roots, {st.get('compiles', 0)} compiles), "
+            f"errstate {sess.get('errstate', 'inherit')}, "
+            f"numlens {sess.get('numlens', 'inherit')}",
+            file=out,
+        )
+        trouble = {
+            k: st.get(k, 0)
+            for k in ("degraded", "quarantine_hits", "mem_refused",
+                      "admission_refused", "admission_waits")
+            if st.get(k)
+        }
+        if trouble:
+            print(f"    incidents: {trouble}", file=out)
+        if sess.get("quarantine"):
+            print(f"    quarantine view: {sess['quarantine']}", file=out)
+        bucket = sess.get("bucket")
+        if bucket:
+            print(
+                f"    bucket: {bucket.get('rate')}/s burst {bucket.get('burst')} "
+                f"— {bucket.get('admitted', 0)} admitted, "
+                f"{bucket.get('refused', 0)} refused",
+                file=out,
+            )
+
+
 def _numerics_doc(report_path: Optional[str]) -> Dict[str, Any]:
     """The numerics picture to render: a saved report's (or flight-dump
     bundle's) ``numerics`` block when a path is given, else THIS process's
@@ -528,6 +596,20 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
         "process's live numerics block (pure module state, no mesh bring-up)",
     )
     p_num.add_argument("--json", action="store_true", help="emit JSON instead of text")
+    p_sess = sub.add_parser(
+        "sessions",
+        help="serving layer: per-session billing/incident blocks, admission "
+        "buckets and the persistent program cache (from a report_json "
+        "artifact, or live from this process)",
+    )
+    p_sess.add_argument(
+        "report",
+        nargs="?",
+        default=None,
+        help="a report_json artifact; omitted = THIS process's live serving "
+        "block (pure module state, no mesh bring-up)",
+    )
+    p_sess.add_argument("--json", action="store_true", help="emit JSON instead of text")
     p_ana = sub.add_parser(
         "analyze",
         help="tracelens diagnosis of a trace: time attribution per bucket, "
@@ -599,6 +681,13 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
             print(json.dumps(_core._jsonable(doc), indent=2, sort_keys=True), file=out)
         else:
             _show_numerics(doc, out)
+        return 0
+    if args.cmd == "sessions":
+        doc = _sessions_doc(args.report)
+        if args.json:
+            print(json.dumps(_core._jsonable(doc), indent=2, sort_keys=True), file=out)
+        else:
+            _show_sessions(doc, out)
         return 0
     if args.cmd == "analyze":
         from heat_tpu.core import tracelens

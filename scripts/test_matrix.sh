@@ -136,6 +136,40 @@ HEAT_TPU_TELEMETRY=1 \
 echo "=== elasticity (HEAT_TPU_FAULTS='elastic.preempt:every=7') ==="
 HEAT_TPU_FAULTS='elastic.preempt:every=7' HEAT_TPU_TELEMETRY=1 \
   python -m pytest tests/test_elastic.py tests/test_checkpoint_resilience.py -q -x
+# serving leg (core/serving.py, ISSUE 15): the multi-tenant session layer —
+# the suite drives N=8 threaded clients through session isolation, admission
+# gates and cross-session batching (zero steady-state retraces, flat p99);
+# then the persistent program cache's cross-process contract runs for real:
+# a COLD process populates HEAT_TPU_PROGRAM_CACHE_DIR, and a second WARM
+# process replaying the same chain must record ZERO compiles (disk warm
+# start — ROADMAP item 4's fresh-process acceptance)
+echo "=== serving (sessions + admission + persistent cache) ==="
+python -m pytest tests/test_serving.py -q -x
+SERVING_CACHE_DIR=$(mktemp -d)
+for leg_name in cold warm; do
+  echo "--- $leg_name process ---"
+  HEAT_TPU_PROGRAM_CACHE_DIR="$SERVING_CACHE_DIR" SERVING_LEG=$leg_name \
+  python - <<'PY'
+import json, os
+import numpy as np
+import heat_tpu as ht
+from heat_tpu.core import serving
+
+a = ht.array(np.arange(48, dtype=np.float32), split=0)
+float(ht.sum(a * 5.0 + 2.0))
+stats = serving.cache_stats()
+leg = os.environ["SERVING_LEG"]
+print(f"{leg}: compiles={stats['compiles']} disk_hits={stats['disk_hits']} "
+      f"index_keys={stats['index_keys']}")
+if leg == "cold":
+    assert stats["compiles"] >= 1, f"cold process compiled nothing: {stats}"
+    assert stats["index_keys"] >= 1, f"cold process banked no keys: {stats}"
+else:
+    assert stats["compiles"] == 0, f"warm process recompiled: {stats}"
+    assert stats["disk_hits"] >= 1, f"warm process missed the index: {stats}"
+PY
+done
+rm -rf "$SERVING_CACHE_DIR"
 # bench regression-sentinel smoke: the file-vs-file compare path (no jax,
 # no measurement) must accept a banked round artifact against itself —
 # exercises record loading, envelope unwrap and threshold plumbing

@@ -102,7 +102,7 @@ class TestFlightRing(HealthCase):
             self.assertIn("dispatch", kinds)
         # mode 1 is aggregate-only: the verbose per-state timeline must not
         # have been fed — the ring is the ONLY event capture at this mode
-        self.assertEqual(len(telemetry._STATES[0].events), 0)
+        self.assertEqual(len(telemetry._GLOBAL.events), 0)
 
     @unittest.skipUnless(fusion.active(), "flight events ride the fused dispatch/sync seams")
     def test_ring_cap_evicts_and_counts_drops(self):

@@ -426,7 +426,13 @@ _STATS = {
 # telemetry ``_MEM_HOOK`` set-attribute pattern; each costs one ``is None``
 # check per force when the serving layer is not in use):
 _DISK_INDEX = None  # persistent program-key index: disk warm-start accounting
-_ADMIT_HOOK = None  # token-bucket admission gate, composed BEFORE memledger's
+# token-bucket admission gate, composed BEFORE memledger's. Fires in force()
+# BEFORE _FORCE_LOCK is taken: the `wait` policy sleeps until refill, and a
+# rate-limited tenant sleeping under the force lock would convoy every other
+# session's dispatches behind it. Called as _ADMIT_HOOK(cid) -> refund|None;
+# the refund is invoked when the admitted dispatch never runs (a neighbour's
+# batch materialized the node during the wait).
+_ADMIT_HOOK = None
 _SERVING_NOTE = None  # per-session incident/billing notes
 _SESSION_OF = None  # resolves the calling thread's active Session id
 
@@ -584,6 +590,12 @@ def _leaf_key(sig) -> tuple:
 # already-forced survivors are pruned during gathering.
 _ROOT_SEQ = itertools.count()
 _LIVE_ROOTS: "weakref.WeakValueDictionary[int, object]" = weakref.WeakValueDictionary()
+# guards registry MUTATION and key snapshots: record()/register_root runs on
+# client threads WITHOUT _FORCE_LOCK (the batch window exists precisely so
+# other threads can register roots while a force is in flight), so an
+# unsynchronized sorted(_LIVE_ROOTS.keys()) in the gather/drain loops could
+# raise "dictionary changed size during iteration" mid-force
+_ROOTS_LOCK = threading.Lock()
 
 
 def register_root(wrapper) -> None:
@@ -591,7 +603,15 @@ def register_root(wrapper) -> None:
     async-forcing batch candidate (every deferral site calls this). No-op
     with collective-aware fusion off — forcing then never batches."""
     if _COLLECTIVES:
-        _LIVE_ROOTS[next(_ROOT_SEQ)] = wrapper
+        with _ROOTS_LOCK:
+            _LIVE_ROOTS[next(_ROOT_SEQ)] = wrapper
+
+
+def _live_root_keys() -> list:
+    """Stable snapshot of the registry's keys, safe against concurrent
+    ``register_root`` inserts from other client threads."""
+    with _ROOTS_LOCK:
+        return sorted(_LIVE_ROOTS.keys())
 
 
 def _node_nbytes(node: LazyArray) -> int:
@@ -637,7 +657,7 @@ def _gather_batch(entries, leaves, memo, roots):
     if device_set is None:
         return  # no placed operand to anchor the mesh: skip batching
     stale = []
-    for key in sorted(_LIVE_ROOTS.keys()):
+    for key in _live_root_keys():
         if len(roots) >= _BATCH_MAX:
             break
         wrapper = _LIVE_ROOTS.get(key)
@@ -657,8 +677,9 @@ def _gather_batch(entries, leaves, memo, roots):
             continue  # different comm/mesh: never fuse across device sets
         _walk(payload, entries, leaves, memo)
         roots.append(payload)
-    for key in stale:
-        _LIVE_ROOTS.pop(key, None)
+    with _ROOTS_LOCK:
+        for key in stale:
+            _LIVE_ROOTS.pop(key, None)
 
 
 def _static_peak(key: str, leaves, roots) -> Tuple[int, str]:
@@ -697,7 +718,7 @@ def _drain_pending_roots(exclude=()):
     prev, _DRAIN_EXCLUDE = _DRAIN_EXCLUDE, _DRAIN_EXCLUDE | frozenset(exclude)
     drained = 0
     try:
-        for key in sorted(_LIVE_ROOTS.keys()):
+        for key in _live_root_keys():
             wrapper = _LIVE_ROOTS.get(key)
             if wrapper is None:
                 continue
@@ -798,6 +819,27 @@ def force(node):
         time.sleep(_BATCH_WINDOW_S)
         if node._value is not None:
             return node._value
+    # local capture: a concurrent last-session exit may uninstall the hook
+    # between the None check and the call
+    admit = _ADMIT_HOOK
+    if admit is not None and not getattr(_FORCE_TLS, "held", 0):
+        # serving admission gate (core/serving.py): per-session + global
+        # token buckets, BEFORE the force lock so a tenant blocked on refill
+        # (`wait` policy) never convoys other sessions' dispatches behind
+        # _FORCE_LOCK, and before memledger's headroom gate — cheap rate
+        # math before ledger walks. A refusal surfaces AdmissionError with
+        # the chain intact: still pending, never degraded, dispatchable once
+        # tokens refill — exactly the admission_hold contract. Recursive
+        # forces (the drain policy, already holding the lock) are exempt:
+        # they dispatch on behalf of an already-admitted force, and gating
+        # them would sleep under the lock.
+        refund = admit(node.cid)
+        if node._value is not None:
+            # a neighbour's batch landed this node while we waited for
+            # tokens: no dispatch happens, so the token goes back
+            if refund is not None:
+                refund()
+            return node._value
     # one force at a time: concurrent serving clients serialize here (the
     # lock is reentrant for the drain policy's recursive forces). Re-check
     # after acquiring — another thread's batch may have materialized us.
@@ -827,6 +869,7 @@ def _force_locked(node):
     sig = tuple(entries)
     _STATS["forces"] += 1
     info = None  # per-program accounting; stays None for eager replays
+    disk_warm = False  # this force's miss was served by the persistent index
     if _QUARANTINE and sig in _QUARANTINE:
         # known-bad DAG key: skip the failing compile, replay per-op
         _STATS["quarantine_hits"] += 1
@@ -877,16 +920,6 @@ def _force_locked(node):
             telemetry.record_force(
                 telemetry.current_trigger(), node.depth, compiled=missed, cid=node.cid
             )
-        if _ADMIT_HOOK is not None:
-            # serving admission gate (core/serving.py): per-session + global
-            # token buckets at the SAME pre-dispatch seam as memledger's
-            # headroom gate (and before it — cheap rate math before ledger
-            # walks). A refusal surfaces AdmissionError with the chain
-            # intact: still pending, never degraded, dispatchable once
-            # tokens refill — exactly the admission_hold contract.
-            _ADMIT_HOOK(info["key"], node.cid, len(roots))
-            if node._value is not None:  # pragma: no cover - belt and braces
-                return node._value
         if memledger._BUDGET_RAW is not None or memledger._HOLD is not None:
             # headroom admission gate (core/memledger.py): live ledger bytes
             # + this program's static peak against HEAT_TPU_MEMORY_BUDGET.
@@ -996,10 +1029,13 @@ def _force_locked(node):
     if _SERVING_NOTE is not None and info is not None:
         # per-tenant billing for a (possibly cross-session) shared dispatch:
         # each session is charged for ITS roots, and the compile (if any)
-        # for the triggering session only
+        # for the triggering session only. A disk warm-start is NOT billed
+        # as a compile — session reports must agree with the global retrace
+        # counter, which disk hits leave untouched.
         _SERVING_NOTE(
             "dispatch", program=info["key"], sessions=sessions,
-            compiled=missed, trigger=getattr(node, "session", None),
+            compiled=missed and not disk_warm,
+            trigger=getattr(node, "session", None),
         )
     if telemetry._MODE:
         telemetry.record_async_dispatch(
@@ -1051,7 +1087,8 @@ def clear_cache() -> None:
     _REPL_COSTS.clear()
     _COST_ERROR_KEYS.clear()  # the once-per-session warn flag survives
     _QUARANTINE.clear()
-    _LIVE_ROOTS.clear()
+    with _ROOTS_LOCK:
+        _LIVE_ROOTS.clear()
     _STATS.update(
         compiles=0, hits=0, disk_hits=0, forces=0, evictions=0, degraded=0,
         quarantine_hits=0,

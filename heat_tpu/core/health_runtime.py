@@ -794,7 +794,7 @@ def _pending_roots() -> List[dict]:
         from . import fusion
 
         out = []
-        for key in sorted(fusion._LIVE_ROOTS.keys()):
+        for key in fusion._live_root_keys():
             wrapper = fusion._LIVE_ROOTS.get(key)
             payload = getattr(wrapper, "_payload", None)
             if isinstance(payload, fusion.LazyArray) and payload._value is None:

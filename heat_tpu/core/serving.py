@@ -31,11 +31,13 @@ not take the process down. Corrupt index lines are skipped with one warning.
 
 **Admission control** — a token-bucket gate on fused dispatches
 (``HEAT_TPU_ADMISSION_RATE`` tokens/s, ``HEAT_TPU_ADMISSION_BURST`` bucket
-depth), with one global bucket and optionally one per session, installed at
-the SAME pre-dispatch seam as memledger's headroom gate and composed before
-it. A refused chain stays fully intact — still pending, never degraded,
-never double-dispatched — exactly the ``admission_hold`` contract: under
-the default ``wait`` policy the force blocks until tokens refill, under
+depth), with one global bucket and optionally one per session, fired in
+``fusion.force()`` BEFORE the force lock is taken (a tenant sleeping for
+refill must block only itself, never convoy neighbours' dispatches behind
+the lock) and composed before memledger's headroom gate. A refused chain
+stays fully intact — still pending, never degraded, never
+double-dispatched — exactly the ``admission_hold`` contract: under the
+default ``wait`` policy the force blocks until tokens refill, under
 ``raise`` (``HEAT_TPU_ADMISSION_POLICY=raise``) an :class:`AdmissionError`
 names the session and the bucket that refused.
 
@@ -117,10 +119,19 @@ class _TokenBucket:
             return (1.0 - self.tokens) / self.rate if self.rate > 0 else 60.0
 
     def give_back(self) -> None:
-        """Refund a taken token (a later bucket in the chain refused)."""
+        """Refund a taken token (a later bucket in the chain refused, or the
+        admitted dispatch never ran)."""
         with self._lock:
             self.tokens = min(self.burst, self.tokens + 1.0)
             self.admitted -= 1
+
+    def refuse(self) -> None:
+        with self._lock:
+            self.refused += 1
+
+    def note_wait(self, seconds: float) -> None:
+        with self._lock:
+            self.waited_s += seconds
 
     def stats(self) -> Dict[str, Any]:
         return {
@@ -283,7 +294,10 @@ class _DiskIndex:
 # ----------------------------------------------------------------------
 # module state
 # ----------------------------------------------------------------------
-_LOCK = threading.Lock()
+# RLock: Session.__enter__/__exit__ install/uninstall the fusion hooks while
+# holding it (so a last-exit teardown cannot race a concurrent first-enter
+# and disarm a live session's gates), and the helpers they call take it too
+_LOCK = threading.RLock()
 _TLS = threading.local()  # per-thread stack of active Sessions
 _SESSION_SEQ = itertools.count(1)
 #: every session ever entered this telemetry session, active or exited,
@@ -333,8 +347,9 @@ def _bill(names, field: str, per_root: bool = False) -> None:
     for n in names:
         if n is not None:
             seen[n] = seen.get(n, 0) + 1
-    for n, count in seen.items():
-        sess = _SESSIONS.get(n)
+    with _LOCK:  # reset() deletes exited entries concurrently
+        resolved = [(_SESSIONS.get(n), count) for n, count in seen.items()]
+    for sess, count in resolved:
         if sess is not None:
             sess.stats[field] += count if per_root else 1
 
@@ -349,7 +364,8 @@ def _on_note(kind: str, **data) -> None:
             _bill(sessions, "roots", per_root=True)
             trigger = data.get("trigger")
             if data.get("compiled") and trigger is not None:
-                sess = _SESSIONS.get(trigger)
+                with _LOCK:
+                    sess = _SESSIONS.get(trigger)
                 if sess is not None:
                     sess.stats["compiles"] += 1
             return
@@ -364,7 +380,8 @@ def _on_note(kind: str, **data) -> None:
             if not names and _current_session() is not None:
                 names = [_current_session().name]
             for n in dict.fromkeys(names):
-                sess = _SESSIONS.get(n)
+                with _LOCK:
+                    sess = _SESSIONS.get(n)
                 if sess is not None:
                     sess.stats["quarantine_hits"] += 1
                     sess._incident(kind, data)
@@ -378,13 +395,20 @@ def _on_note(kind: str, **data) -> None:
         pass
 
 
-def _admit(program: str, cid, n_roots: int) -> None:
+def _admit(cid) -> Optional[Any]:
     """fusion's ``_ADMIT_HOOK`` seam: the token-bucket gate, composed
-    before memledger's headroom gate at the same pre-dispatch point. The
-    session's own bucket is consulted first (cheap containment), then the
-    global one; a raise-refusal refunds the session token so the retry is
-    not double-charged. Under ``wait`` the force blocks until refill —
-    the chain stays pending the whole time, mirroring ``admission_hold``."""
+    before memledger's headroom gate. fusion calls it in ``force()``
+    BEFORE acquiring ``_FORCE_LOCK`` — the ``wait`` policy sleeps until
+    refill, and sleeping under the force lock would let one rate-limited
+    tenant convoy every other session's dispatches for the full refill
+    wait (containment demands the opposite: a tenant tripping its gate
+    blocks only itself). The session's own bucket is consulted first
+    (cheap containment), then the global one; a raise-refusal refunds the
+    session token so the retry is not double-charged. Under ``wait`` the
+    force blocks until refill — the chain stays pending the whole time,
+    mirroring ``admission_hold``. Returns a refund closure fusion invokes
+    when the admitted dispatch never runs (a neighbour's batch landed the
+    value during the wait), or ``None`` when no bucket gated."""
     sess = _current_session()
     buckets: List[_TokenBucket] = []
     if sess is not None and sess.bucket is not None:
@@ -392,7 +416,7 @@ def _admit(program: str, cid, n_roots: int) -> None:
     if _GLOBAL_BUCKET is not None:
         buckets.append(_GLOBAL_BUCKET)
     if not buckets:
-        return
+        return None
     policy = sess.policy if sess is not None and sess.policy else _POLICY
     taken: List[_TokenBucket] = []
     for bucket in buckets:
@@ -402,15 +426,15 @@ def _admit(program: str, cid, n_roots: int) -> None:
                 taken.append(bucket)
                 break
             if policy == "raise":
-                bucket.refused += 1
+                bucket.refuse()
                 for t in taken:  # refund earlier buckets in the chain
                     t.give_back()
                 if sess is not None:
                     sess.stats["admission_refused"] += 1
                     sess._incident("admission_refused",
-                                   {"bucket": bucket.name, "program": program})
+                                   {"bucket": bucket.name, "cid": cid})
                 raise AdmissionError(
-                    f"dispatch of program {program} refused by the "
+                    f"dispatch of chain cid={cid} refused by the "
                     f"{bucket.name} admission bucket for session "
                     f"{sess.name if sess is not None else '<none>'} "
                     f"(rate {bucket.rate}/s, burst {int(bucket.burst)}; "
@@ -418,17 +442,25 @@ def _admit(program: str, cid, n_roots: int) -> None:
                     "chain is still pending and dispatches once tokens refill"
                 )
             # wait policy: the refused chain stays pending and dispatches
-            # when tokens refill (nothing degraded, nothing re-walked)
-            bucket.waited_s += wait
+            # when tokens refill (nothing degraded, nothing re-walked).
+            # The sleep happens on the CALLING tenant's thread only, with
+            # no fusion lock held: neighbours keep dispatching throughout.
+            bucket.note_wait(wait)
             if sess is not None:
                 sess.stats["admission_waits"] += 1
                 sess.stats["admission_waited_s"] += wait
             if telemetry._MODE >= 2:
                 telemetry.record_event(
-                    "admission_wait", bucket=bucket.name, program=program,
+                    "admission_wait", bucket=bucket.name, cid=cid,
                     seconds=round(wait, 6),
                 )
             time.sleep(wait)
+
+    def _refund() -> None:
+        for t in taken:
+            t.give_back()
+
+    return _refund
 
 
 def _install_hooks() -> None:
@@ -549,11 +581,14 @@ class Session:
             _SESSIONS[self.name] = self  # reusing a name rolls the archive over
             self._entered += 1
             _ACTIVE += 1
-        if _ACTIVE == 1 or fusion._SERVING_NOTE is None:
-            _install_hooks()
-        elif self.bucket is not None:
-            _refresh_admit_hook()
-        _refresh_batch_window()
+            # install while still holding _LOCK: a concurrent last-exit in
+            # another thread must not observe _ACTIVE drop to 0, release,
+            # and then tear the hooks down AFTER we installed them
+            if fusion._SERVING_NOTE is None:
+                _install_hooks()
+            elif self.bucket is not None:
+                _refresh_admit_hook()
+            _refresh_batch_window()
         frames = getattr(self._sess_tls, "frames", None)
         if frames is None:
             frames = self._sess_tls.frames = []
@@ -584,12 +619,15 @@ class Session:
         with _LOCK:
             self._entered -= 1
             _ACTIVE -= 1
-            last = _ACTIVE == 0
-        if last:
-            _uninstall_hooks()
-        elif self.bucket is not None:
-            _refresh_admit_hook()
-        _refresh_batch_window()
+            # teardown under the SAME lock as the check: deciding last=True,
+            # releasing, and uninstalling later would race a concurrent
+            # __enter__ (0→1 + install in the window) and silently disarm
+            # the new session's admission/billing/containment hooks
+            if _ACTIVE == 0:
+                _uninstall_hooks()
+            elif self.bucket is not None:
+                _refresh_admit_hook()
+            _refresh_batch_window()
 
     # -- reporting ------------------------------------------------------
     def _incident(self, kind: str, data: Dict[str, Any]) -> None:
@@ -801,7 +839,7 @@ def reset() -> None:
     with _LOCK:
         for name in [n for n, s in _SESSIONS.items() if s._entered == 0]:
             del _SESSIONS[name]
-    _refresh_batch_window()
+        _refresh_batch_window()
     if _GLOBAL_BUCKET is not None:
         with _GLOBAL_BUCKET._lock:
             _GLOBAL_BUCKET.admitted = 0

@@ -250,6 +250,30 @@ class TestPersistentCache(ServingCase):
             self.assertEqual(st["misses"], st["compiles"] + st["disk_hits"])
 
     @pytest.mark.skipif(not fusion.active(), reason="fusion disabled")
+    def test_disk_warm_start_not_billed_as_session_compile(self):
+        """Session `compiles` agrees with the global retrace counter: a
+        disk warm-start is a `disk_hit`, not a billed compile — a
+        warm-started process must bill sessions zero compiles while
+        `cache_stats()["compiles"]` stays 0."""
+        with tempfile.TemporaryDirectory() as d:
+            serving.arm_cache(d)
+            a = self._client_input(22)
+            expect = float(np.sum(a.numpy() * 5.0))
+            with serving.Session("first") as s1:
+                self.assertAlmostEqual(float(ht.sum(a * 5.0)), expect, places=3)
+            self.assertGreaterEqual(s1.stats["compiles"], 1)
+            fusion.clear_cache()  # fresh process: programs gone, index stays
+            a2 = self._client_input(22)
+            with serving.Session("second") as s2:
+                self.assertAlmostEqual(float(ht.sum(a2 * 5.0)), expect, places=3)
+            self.assertGreaterEqual(s2.stats["dispatches"], 1)
+            self.assertEqual(
+                s2.stats["compiles"], 0,
+                "disk warm-start billed as a session compile",
+            )
+            self.assertEqual(serving.cache_stats()["compiles"], 0)
+
+    @pytest.mark.skipif(not fusion.active(), reason="fusion disabled")
     def test_warmup_prebakes_and_seeds(self):
         with tempfile.TemporaryDirectory() as d:
             serving.arm_cache(d)
@@ -383,6 +407,54 @@ class TestAdmission(ServingCase):
         self.assertGreater(waited, 0.05)  # the refill was actually slept
 
     @pytest.mark.skipif(not fusion.active(), reason="fusion disabled")
+    def test_wait_does_not_convoy_neighbor_sessions(self):
+        """The containment contract under `wait`: the refill sleep happens
+        BEFORE the force lock, so a rate-limited tenant blocked on tokens
+        stalls only its own thread — a neighbour session's dispatches run
+        to completion well inside the limited tenant's ~2s refill wait."""
+        fast_done = threading.Event()
+        fast_elapsed = []
+        errors = []
+
+        def limited():
+            try:
+                with serving.Session("slowpoke", admission_rate=0.5,
+                                     admission_burst=1):
+                    a = self._client_input(20)
+                    float(ht.sum(a * 2.0))  # spends the only token
+                    float(ht.sum(a * 3.0))  # sleeps ~2s for the refill
+            except Exception as exc:  # surface thread failures
+                errors.append(exc)
+
+        def unlimited():
+            try:
+                with serving.Session("neighbor"):
+                    b = self._client_input(21)
+                    t0 = time.perf_counter()
+                    for k in range(4, 9):
+                        float(ht.sum(b * float(k)))
+                    fast_elapsed.append(time.perf_counter() - t0)
+            except Exception as exc:
+                errors.append(exc)
+            finally:
+                fast_done.set()
+
+        t1 = threading.Thread(target=limited)
+        t2 = threading.Thread(target=unlimited)
+        t1.start()
+        time.sleep(0.3)  # let the limited tenant reach its refill sleep
+        t2.start()
+        self.assertTrue(fast_done.wait(timeout=10))
+        t1.join(timeout=15)
+        t2.join(timeout=15)
+        self.assertEqual(errors, [])
+        self.assertLess(
+            fast_elapsed[0], 1.5,
+            "neighbour's dispatches convoyed behind the limited tenant's "
+            "admission wait",
+        )
+
+    @pytest.mark.skipif(not fusion.active(), reason="fusion disabled")
     def test_global_bucket_gates_outside_sessions(self):
         serving.set_admission(0.5, 1, policy="raise")
         a = self._client_input(11)
@@ -473,6 +545,48 @@ class TestGateComposition(ServingCase):
                 )
         finally:
             telemetry.set_mode(prev_mode)
+
+
+class TestConcurrentRootRegistration(ServingCase):
+    @pytest.mark.skipif(not fusion.active(), reason="fusion disabled")
+    def test_register_root_during_force_never_crashes(self):
+        """The batch window invites other threads to register roots WHILE a
+        force iterates the live-root registry — the registry key snapshot
+        is taken under ``fusion._ROOTS_LOCK`` so concurrent inserts can
+        never raise "dictionary changed size during iteration" mid-force."""
+        errors = []
+        stop = threading.Event()
+
+        def forcer():
+            try:
+                with serving.Session("forcer"):
+                    a = self._client_input(30)
+                    for _ in range(25):
+                        float(ht.sum(a * 2.0))
+            except Exception as exc:
+                errors.append(exc)
+            finally:
+                stop.set()
+
+        def registrar():
+            try:
+                with serving.Session("registrar"):
+                    b = self._client_input(31)
+                    pending = []
+                    while not stop.is_set():
+                        # each product is a deferred root: register_root
+                        # fires on this thread with no force lock held
+                        pending.append(b * 1.5)
+                        if len(pending) > 256:
+                            pending.clear()
+            except Exception as exc:
+                errors.append(exc)
+
+        t1 = threading.Thread(target=forcer)
+        t2 = threading.Thread(target=registrar)
+        t1.start(); t2.start()
+        t1.join(timeout=60); t2.join(timeout=60)
+        self.assertEqual(errors, [])
 
 
 # ----------------------------------------------------------------------

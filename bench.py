@@ -966,6 +966,96 @@ def worker() -> None:
     except Exception:  # noqa: BLE001 - diagnostics must never cost the record
         pass
 
+    # ops-plane leg (core/opsplane.py, ISSUE 17): the live ops endpoint's
+    # steady-state cost — dispatch rate with the sampler thread armed AND a
+    # client scraping /metrics every 50ms vs fully disarmed
+    # (ops_overhead_pct, paired rounds + median like the flight/numlens
+    # gauges, contract <= 2%: pure module-state reads must be invisible to
+    # the dispatch path), plus the wall time of one warm /metrics GET
+    # against the live registry (metrics_scrape_ms — what a sidecar
+    # Prometheus pays per scrape). Runs AFTER the record is banked
+    # (hang-safety invariant).
+    try:
+        import threading as _op_threading
+        import urllib.request as _op_request
+
+        from heat_tpu.core import opsplane as _opsplane
+
+        if chain_fused:
+            _op_n = (262144 // comm.size) * comm.size
+            _op_k = jax.random.PRNGKey(11)
+            _op_a = ht.array(
+                jax.device_put(
+                    jax.random.normal(_op_k, (_op_n, 4), dtype=jnp.float32),
+                    comm.sharding(2, 0),
+                ),
+                is_split=0,
+            )
+
+            def _op_chain_once():
+                c = ht.exp((_op_a + 1.0) * 2.0) - _op_a
+                return float(ht.sum(ht.abs(c) / (ht.abs(_op_a) + 1.0)).larray)
+
+            def _op_chain_rate():
+                _op_chain_once()
+                start = time.perf_counter()
+                for _ in range(256):
+                    _op_chain_once()
+                return 2560.0 / (time.perf_counter() - start)
+
+            def _op_median(xs):
+                xs = sorted(xs)
+                mid = len(xs) // 2
+                return xs[mid] if len(xs) % 2 else 0.5 * (xs[mid - 1] + xs[mid])
+
+            def _op_scraper(url, stop):
+                while not stop.is_set():
+                    try:
+                        with _op_request.urlopen(url, timeout=5) as r:
+                            r.read()
+                    except Exception:  # noqa: BLE001 - scrape noise is fine
+                        pass
+                    stop.wait(0.05)
+
+            overheads = []
+            with _telemetry.enabled():
+                for _ in range(9):
+                    _opsplane.shutdown()
+                    _op_off = _op_chain_rate()
+                    _op_port = _opsplane.serve(port=0)
+                    _op_stop = _op_threading.Event()
+                    _op_thread = _op_threading.Thread(
+                        target=_op_scraper,
+                        args=(f"http://127.0.0.1:{_op_port}/metrics", _op_stop),
+                    )
+                    _op_thread.start()
+                    try:
+                        if _op_off:
+                            overheads.append(
+                                100.0 * (1.0 - _op_chain_rate() / _op_off)
+                            )
+                    finally:
+                        _op_stop.set()
+                        _op_thread.join(timeout=30)
+            if overheads:
+                record["ops_overhead_pct"] = round(_op_median(overheads), 1)
+            # one warm /metrics GET against the registry the rounds above
+            # populated — registry fold + exposition render + HTTP roundtrip
+            _op_port = _opsplane.serve(port=0)
+            _op_url = f"http://127.0.0.1:{_op_port}/metrics"
+            with _op_request.urlopen(_op_url, timeout=10) as r:
+                r.read()  # warm: first GET pays one-time route setup
+            start = time.perf_counter()
+            with _op_request.urlopen(_op_url, timeout=10) as r:
+                r.read()
+            record["metrics_scrape_ms"] = round(
+                (time.perf_counter() - start) * 1e3, 2
+            )
+            _opsplane.shutdown()
+            print(json.dumps(record), flush=True)  # last parseable line wins
+    except Exception:  # noqa: BLE001 - diagnostics must never cost the record
+        pass
+
     # static-analysis leg (heat_tpu/analysis, ISSUE 7): the AST lint's wall
     # time over the library (the pre-commit budget a CI hook would pay) and
     # the AOT program auditor's finding count over the program cache the
@@ -1542,6 +1632,7 @@ _OVERHEAD_CEILINGS = {
     "memory_ledger_overhead_pct": 5.0,
     "guarded_dispatch_overhead_pct": 10.0,
     "numlens_overhead_pct": 2.0,
+    "ops_overhead_pct": 2.0,
 }
 
 #: static-analysis counters that must never grow between rounds
@@ -1588,6 +1679,13 @@ _ELASTIC_CEILINGS = {
 _SERVING_CEILINGS = {
     "serving_p99_ms_n1": 10.0,
     "serving_p99_ms_n8": 25.0,
+}
+
+#: ops-plane scrape cost with an absolute ceiling (wall time of one warm
+#: /metrics GET: registry fold + exposition render + local HTTP roundtrip);
+#: same ``max(ceiling, banked*1.5+2.0)`` noise logic as the overhead gauges
+_OPS_CEILINGS = {
+    "metrics_scrape_ms": 250.0,
 }
 
 #: serving counters that must be EXACTLY zero — steady-state traffic never
@@ -1688,6 +1786,18 @@ def compare_records(fresh: dict, banked: dict, slack: float = 0.30) -> dict:
                 f"(ceiling {ceiling:g}, banked {b if b is not None else 'n/a'})"
             )
     for key, ceiling in _TRACELENS_CEILINGS.items():
+        f, b = _num(fresh, key), _num(banked, key)
+        if f is None:
+            if b is not None:
+                notes.append(f"{key}: banked={b:g} but missing from fresh record")
+            continue
+        limit = ceiling if b is None else max(ceiling, b * 1.5 + 2.0)
+        if f > limit:
+            regressions.append(
+                f"{key}: fresh {f:g} > limit {limit:g} "
+                f"(ceiling {ceiling:g}, banked {b if b is not None else 'n/a'})"
+            )
+    for key, ceiling in _OPS_CEILINGS.items():
         f, b = _num(fresh, key), _num(banked, key)
         if f is None:
             if b is not None:

@@ -28,6 +28,7 @@ from .core import (
     memledger,
     memory,
     numlens,
+    opsplane,
     printing,
     relational,
     resilience,

@@ -26,6 +26,7 @@ from . import numlens
 from . import fusion
 from . import elastic
 from . import serving
+from . import opsplane
 from .dndarray import *
 from .factories import *
 from .memory import *

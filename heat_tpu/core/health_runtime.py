@@ -501,13 +501,28 @@ _SLO_LIMITS: Dict[str, Optional[float]] = {  # seconds; None = no SLO set
     "compile": _env_ms("HEAT_TPU_SLO_COMPILE_MS"),
 }
 _SLO_WINDOW_S = max(1.0, _env_float("HEAT_TPU_SLO_WINDOW_S", 300.0))
+#: samples are ``(perf_counter_ts, seconds, tenant)`` — the tenant tag is
+#: the serving session name active on the recording thread (None outside a
+#: session), the label opsplane's per-tenant burn-rate windows group by
 _SLO_SAMPLES: Dict[str, deque] = {m: deque(maxlen=2048) for m in _METRICS}
 _SLO_BREACHES: Dict[str, int] = {m: 0 for m in _METRICS}
+
+#: set-attribute seam (the memledger ``_MEM_HOOK`` pattern): serving
+#: installs its ``_current_session_name`` here so SLO samples carry the
+#: tenant without this module importing the serving layer
+_TENANT_HOOK = None
 
 
 def _slo_observe(metric: str, v: float) -> None:
     now = time.perf_counter()
-    _SLO_SAMPLES[metric].append((now, v))
+    tenant = None
+    if _TENANT_HOOK is not None:
+        try:
+            tenant = _TENANT_HOOK()
+        # the tag is best-effort; a latency sample must never fail to land
+        except Exception:  # noqa: BLE001
+            tenant = None
+    _SLO_SAMPLES[metric].append((now, v, tenant))
     limit = _SLO_LIMITS.get(metric)
     if limit is not None and v > limit:
         _SLO_BREACHES[metric] += 1
@@ -516,6 +531,7 @@ def _slo_observe(metric: str, v: float) -> None:
             metric=metric,
             value_ms=round(v * 1e3, 3),
             limit_ms=round(limit * 1e3, 3),
+            tenant=tenant,
         )
 
 
@@ -541,7 +557,7 @@ def _slo_block() -> Dict[str, Any]:
     out: Dict[str, Any] = {"window_s": _SLO_WINDOW_S}
     for metric, dq in _SLO_SAMPLES.items():
         limit = _SLO_LIMITS[metric]
-        vals = sorted(v for ts, v in dq if now - ts <= _SLO_WINDOW_S)
+        vals = sorted(s[1] for s in dq if now - s[0] <= _SLO_WINDOW_S)
         entry: Dict[str, Any] = {
             "limit_ms": None if limit is None else round(limit * 1e3, 3),
             "recent": len(vals),

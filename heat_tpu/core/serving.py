@@ -60,7 +60,7 @@ import warnings
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
 
-from . import fusion, memledger, numlens, resilience, telemetry
+from . import fusion, health_runtime, memledger, numlens, resilience, telemetry
 
 __all__ = [
     "AdmissionError",
@@ -856,3 +856,9 @@ if _env_cache_dir is not None:
 if _ENV_RATE is not None:
     _GLOBAL_BUCKET = _TokenBucket(_ENV_RATE, _ENV_BURST, "global")
     _refresh_admit_hook()
+
+# per-session label export (set-attribute, like the fusion seams): SLO
+# latency samples carry the recording thread's session name, so the ops
+# plane's burn-rate windows can group per tenant without health_runtime
+# importing the serving layer
+health_runtime._TENANT_HOOK = _current_session_name

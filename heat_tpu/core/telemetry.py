@@ -548,6 +548,12 @@ def reset() -> None:
         serving.reset()
     except Exception:  # pragma: no cover - import-order safety only
         pass
+    try:
+        from . import opsplane
+
+        opsplane.reset()
+    except Exception:  # pragma: no cover - import-order safety only
+        pass
 
 
 # ----------------------------------------------------------------------
@@ -2021,6 +2027,22 @@ class _MetricsSink:
             # snapshot whatever request scope the main thread is inside
             doc = report(_state=_GLOBAL)
             doc.pop("events", None)  # the timeline has its own exporter
+            # stable line schema: report() only joins the serving block
+            # when sessions exist and the elastic block when the hook is
+            # installed, but a streaming consumer needs every line to
+            # carry the same keys — fill the conditional blocks in
+            if "serving" not in doc:
+                try:
+                    from . import serving
+
+                    doc["serving"] = serving.sessions_block()
+                except Exception:  # noqa: BLE001 - sink lines never fail
+                    doc["serving"] = {}
+            if "elastic" not in doc:
+                try:
+                    doc["elastic"] = {} if _ELASTIC_HOOK is None else _ELASTIC_HOOK()
+                except Exception:  # noqa: BLE001 - sink lines never fail
+                    doc["elastic"] = {}
             line = json.dumps(
                 _jsonable({"ts": time.time(), "event": event, "report": doc}),
                 default=str,

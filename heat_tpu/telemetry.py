@@ -16,6 +16,9 @@ exported Chrome/Perfetto trace files without writing any analysis code:
     $ python -m heat_tpu.telemetry health flight_dump.json
     $ python -m heat_tpu.telemetry numerics               # stats/drift/SDC lens
     $ python -m heat_tpu.telemetry numerics report.json --json
+    $ python -m heat_tpu.telemetry ops scrape --port 9464       # GET /metrics
+    $ python -m heat_tpu.telemetry ops check --port 9464        # strict exposition + /healthz
+    $ python -m heat_tpu.telemetry ops serve --port 9464        # serve this process
 
 The implementation (and all state) lives in :mod:`heat_tpu.core.telemetry`;
 this module is a thin proxy (``heat_tpu.telemetry.report`` etc. delegate
@@ -456,6 +459,118 @@ def _show_sessions(doc: Dict[str, Any], out) -> None:
             )
 
 
+# ----------------------------------------------------------------------
+# ops: scrape / check / serve against a live ops-plane endpoint
+# ----------------------------------------------------------------------
+def _ops_base(args) -> str:
+    if args.url:
+        return args.url.rstrip("/")
+    if args.port is None:
+        raise SystemExit("ops: pass --url or --port to reach a live endpoint")
+    return f"http://{args.host}:{int(args.port)}"
+
+
+def _ops_get(url: str, timeout: float):
+    """One GET: ``(status_code, body_text)`` — an HTTP error status is a
+    result to report, not an exception."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read().decode("utf-8", "replace")
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode("utf-8", "replace")
+
+
+def _ops_scrape(args, out) -> int:
+    url = _ops_base(args) + args.path
+    try:
+        code, body = _ops_get(url, args.timeout)
+    except OSError as exc:
+        print(f"ERROR: {url}: {exc}", file=out)
+        return 1
+    print(body, end="" if body.endswith("\n") else "\n", file=out)
+    return 0 if code == 200 else 1
+
+
+def _ops_check(args, out) -> int:
+    """The strict endpoint check the test matrix runs mid-traffic: the
+    ``/metrics`` exposition must validate (types, HELP lines, no duplicate
+    samples, schema'd names only) and ``/healthz`` must answer 200."""
+    from heat_tpu.core import opsplane
+
+    base = _ops_base(args)
+    rc = 0
+    try:
+        code, text = _ops_get(base + "/metrics", args.timeout)
+    except OSError as exc:
+        print(f"ERROR: {base}/metrics: {exc}", file=out)
+        return 1
+    if code != 200:
+        print(f"FAIL: /metrics answered {code}", file=out)
+        return 1
+    problems = opsplane.validate_exposition(text)
+    names = {
+        line.split("{")[0].split()[0]
+        for line in text.splitlines()
+        if line and not line.startswith("#")
+    }
+    known = set(opsplane.SCHEMA)
+    for mtype in ("histogram",):
+        for name, spec in opsplane.SCHEMA.items():
+            if spec[0] == mtype:
+                known.update({name + s for s in ("_bucket", "_sum", "_count")})
+    for name in sorted(names - known):
+        problems.append(f"unschema'd metric name {name!r} (doc/metrics_schema.json)")
+    if problems:
+        for p in problems[:20]:
+            print(f"INVALID: {p}", file=out)
+        rc = 1
+    else:
+        samples = sum(
+            1 for ln in text.splitlines() if ln and not ln.startswith("#")
+        )
+        print(
+            f"OK: /metrics parses as Prometheus exposition "
+            f"({len(names)} families, {samples} samples)",
+            file=out,
+        )
+    try:
+        code, body = _ops_get(base + "/healthz", args.timeout)
+    except OSError as exc:
+        print(f"ERROR: {base}/healthz: {exc}", file=out)
+        return 1
+    if code == 200:
+        print("OK: /healthz answers 200", file=out)
+    else:
+        print(f"FAIL: /healthz answered {code}: {body.strip()[:200]}", file=out)
+        rc = 1
+    return rc
+
+
+def _ops_serve(args, out) -> int:
+    """Arm THIS process's ops plane and block — the sidecar-inspection
+    entry (live module state; an idle CLI process exports mostly zeros,
+    which is still a scrape target for wiring checks)."""
+    import time as _time
+
+    from heat_tpu.core import opsplane
+
+    try:
+        port = opsplane.serve(port=args.port, host=args.host)
+    except ValueError as exc:
+        print(f"ERROR: {exc}", file=out)
+        return 2
+    print(f"ops plane listening on http://{args.host}:{port}", file=out, flush=True)
+    try:
+        while True:
+            _time.sleep(3600)
+    except KeyboardInterrupt:
+        opsplane.shutdown()
+    return 0
+
+
 def _numerics_doc(report_path: Optional[str]) -> Dict[str, Any]:
     """The numerics picture to render: a saved report's (or flight-dump
     bundle's) ``numerics`` block when a path is given, else THIS process's
@@ -638,6 +753,19 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
         help="analyze a window with dropped events anyway (attribution "
         "undercounts the evicted prefix; refused with exit 2 otherwise)",
     )
+    p_ops = sub.add_parser(
+        "ops",
+        help="live ops plane: scrape an endpoint, strict-check its "
+        "/metrics exposition + /healthz, or serve this process's plane",
+    )
+    p_ops.add_argument("action", choices=("scrape", "check", "serve"))
+    p_ops.add_argument("--url", default=None, help="endpoint base URL (overrides --host/--port)")
+    p_ops.add_argument("--host", default="127.0.0.1")
+    p_ops.add_argument("--port", type=int, default=None)
+    p_ops.add_argument(
+        "--path", default="/metrics", help="route for 'scrape' (default /metrics)"
+    )
+    p_ops.add_argument("--timeout", type=float, default=10.0)
     p_val = sub.add_parser(
         "validate-trace", help="check a Chrome/Perfetto trace-event JSON file"
     )
@@ -689,6 +817,12 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
         else:
             _show_sessions(doc, out)
         return 0
+    if args.cmd == "ops":
+        if args.action == "scrape":
+            return _ops_scrape(args, out)
+        if args.action == "check":
+            return _ops_check(args, out)
+        return _ops_serve(args, out)
     if args.cmd == "analyze":
         from heat_tpu.core import tracelens
 

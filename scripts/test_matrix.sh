@@ -170,6 +170,70 @@ else:
 PY
 done
 rm -rf "$SERVING_CACHE_DIR"
+# ops-plane leg (core/opsplane.py, ISSUE 17): the live ops endpoint ARMED
+# while N=8 threaded tenants drive real traffic — mid-traffic scrapes of
+# /metrics + /healthz must be thread-safe and the exposition must pass the
+# strict parser check (types, HELP lines, no duplicate samples, schema'd
+# names only), exactly as a sidecar Prometheus would see it
+echo "=== ops plane (HEAT_TPU_OPS_PORT armed during serving traffic) ==="
+HEAT_TPU_OPS_PORT=0 python -m pytest tests/test_opsplane.py -q -x
+HEAT_TPU_OPS_PORT=0 python - <<'PY'
+import io, threading, urllib.request
+import numpy as np
+import heat_tpu as ht
+from heat_tpu.core import opsplane, serving
+import heat_tpu.telemetry as cli
+
+port = opsplane.status()["port"]
+assert port, "HEAT_TPU_OPS_PORT=0 did not arm the ops server"
+
+def chain(arr, k):
+    return ht.sum(arr * k + 1.0)
+
+arrs = [
+    ht.array(
+        np.random.default_rng(i).normal(size=(256,)).astype(np.float32), split=0
+    )
+    for i in range(8)
+]
+# prebake every batch-size signature so steady state never retraces
+for k in range(1, 9):
+    outs = [chain(arrs[j], 1.0 + j * 0.25) for j in range(k)]
+    for o in outs:
+        float(o)
+
+barrier = threading.Barrier(9)
+errors = []
+
+def client(i):
+    try:
+        with serving.Session(f"matrix{i}"):
+            barrier.wait(timeout=30)
+            for r in range(30):
+                float(chain(arrs[i], 1.0 + r * 0.25))
+    except Exception as exc:
+        errors.append(exc)
+
+threads = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+for t in threads:
+    t.start()
+barrier.wait(timeout=30)
+# mid-traffic: the strict check (parser-valid /metrics + /healthz 200)
+out = io.StringIO()
+rc = cli.main(["ops", "check", "--port", str(port)], out=out)
+print(out.getvalue().rstrip())
+assert rc == 0, f"mid-traffic ops check failed:\n{out.getvalue()}"
+with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+    text = r.read().decode()
+assert 'tenant="matrix' in text, "no per-tenant counters on /metrics mid-traffic"
+for t in threads:
+    t.join(timeout=120)
+assert not errors, errors
+with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+    problems = opsplane.validate_exposition(r.read().decode())
+assert not problems, problems
+print("ops leg: mid-traffic scrape clean, per-tenant labels present")
+PY
 # bench regression-sentinel smoke: the file-vs-file compare path (no jax,
 # no measurement) must accept a banked round artifact against itself —
 # exercises record loading, envelope unwrap and threshold plumbing

@@ -1,0 +1,603 @@
+"""Live ops plane (ISSUE 17): the streaming metrics registry, the
+Prometheus/JSON ops server, and multi-window SLO burn-rate alerting.
+
+Pins the acceptance criteria: ``/metrics`` is parser-valid Prometheus
+exposition whose names all come from the committed schema
+(``doc/metrics_schema.json`` — a rename fails here before it breaks a
+dashboard); concurrent scrapes during N=8 threaded serving sessions are
+thread-safe, never force a pending chain and never initialize an
+uninitialized backend (subprocess-pinned); per-tenant exposition counters
+match ``sess.report()`` billing exactly; a synthetically injected latency
+fault flips the fast-window ``slo_burn`` alert (event + finding + gauge)
+and degrades ``/healthz``, and the alert clears once the window drains;
+and the ``HEAT_TPU_METRICS`` JSON-lines sink emits a stable line schema
+carrying every report block (``serving``/``elastic``/``health``/
+``numerics`` included — the post-PR 6 blocks it used to drop).
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import unittest
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core import (
+    communication,
+    fusion,
+    health_runtime,
+    opsplane,
+    resilience,
+    serving,
+    telemetry,
+)
+
+from harness import TestCase
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _get(port, route, timeout=10.0):
+    """One GET against the local ops server: (status, body)."""
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{route}", timeout=timeout
+        ) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode()
+
+
+class OpsCase(TestCase):
+    """Clean ops/serving/telemetry state; exact under the CI fault mix."""
+
+    def setUp(self):
+        self._suspend = resilience.suspended()
+        self._suspend.__enter__()
+        fusion.clear_cache()
+        telemetry.reset()
+        opsplane.reset()
+        self._prev_slo = health_runtime.set_slo(
+            sync_ms=None, dispatch_ms=None, compile_ms=None
+        )
+        self._prev_burn = opsplane.set_burn()
+        serving.set_admission(None)
+
+    def tearDown(self):
+        opsplane.shutdown()
+        opsplane.set_burn(**{
+            k: self._prev_burn[k]
+            for k in ("target", "fast_s", "slow_s", "threshold", "min_samples")
+        })
+        health_runtime.set_slo(
+            sync_ms=None if self._prev_slo["sync"] is None else self._prev_slo["sync"] * 1e3,
+            dispatch_ms=None if self._prev_slo["dispatch"] is None else self._prev_slo["dispatch"] * 1e3,
+            compile_ms=None if self._prev_slo["compile"] is None else self._prev_slo["compile"] * 1e3,
+        )
+        serving.set_admission(None)
+        telemetry.reset()
+        self._suspend.__exit__(None, None, None)
+
+
+# ----------------------------------------------------------------------
+# the registry + the committed metric-name schema
+# ----------------------------------------------------------------------
+class TestSchema(OpsCase):
+    def test_committed_schema_matches_registry(self):
+        """doc/metrics_schema.json IS the exporter contract: any rename,
+        removal, type flip or label change must land in the committed file
+        (and therefore in review) or fail here."""
+        with open(os.path.join(_REPO, "doc", "metrics_schema.json")) as fh:
+            committed = json.load(fh)
+        self.assertEqual(
+            committed,
+            opsplane.schema(),
+            "doc/metrics_schema.json and opsplane.SCHEMA diverged — "
+            "regenerate the file (json.dump(opsplane.schema(), ...)) and "
+            "treat the diff as a dashboard-breaking change",
+        )
+
+    def test_collect_emits_only_schemad_names_and_labels(self):
+        with serving.Session("schema-tenant"):
+            float(ht.sum(ht.array(np.ones(8, dtype=np.float32), split=0) * 2.0))
+        samples = opsplane.collect()
+        self.assertGreater(len(samples), 20)
+        for name, labels, value in samples:
+            self.assertIn(name, opsplane.SCHEMA, f"unschema'd metric {name}")
+            spec_labels = set(opsplane.SCHEMA[name][2])
+            self.assertEqual(
+                set(labels), spec_labels,
+                f"{name}: labels {sorted(labels)} != schema {sorted(spec_labels)}",
+            )
+            self.assertIsInstance(value, float)
+
+    def test_series_accumulate_and_reset_clears(self):
+        opsplane.sample()
+        opsplane.sample()
+        pts = opsplane.series("heat_tpu_up", {})
+        self.assertGreaterEqual(len(pts), 2)
+        for ts, v in pts:
+            self.assertEqual(v, 1.0)
+        opsplane.reset()
+        self.assertEqual(opsplane.series("heat_tpu_up", {}), [])
+        # config survives a reset (the memledger split)
+        self.assertEqual(opsplane.set_burn()["target"], self._prev_burn["target"])
+
+
+# ----------------------------------------------------------------------
+# Prometheus text exposition: renderer + strict validator
+# ----------------------------------------------------------------------
+class TestExposition(OpsCase):
+    def test_render_is_parser_valid(self):
+        with serving.Session("expo"):
+            float(ht.sum(ht.array(np.ones(8, dtype=np.float32), split=0) + 1.0))
+        opsplane.sample()
+        text = opsplane.render()
+        self.assertEqual(opsplane.validate_exposition(text), [])
+        self.assertIn("# HELP heat_tpu_up", text)
+        self.assertIn("# TYPE heat_tpu_session_dispatches_total counter", text)
+        self.assertIn('tenant="expo"', text)
+
+    def test_latency_histogram_is_native(self):
+        # the latency seams record only under telemetry, like the bench legs
+        with telemetry.enabled():
+            float(ht.sum(ht.array(np.ones(8, dtype=np.float32), split=0) * 3.0))
+        text = opsplane.render()
+        self.assertIn("# TYPE heat_tpu_latency_seconds histogram", text)
+        self.assertIn('heat_tpu_latency_seconds_bucket{le="+Inf",metric="dispatch"}', text)
+        self.assertIn('heat_tpu_latency_seconds_count{metric="dispatch"}', text)
+        self.assertEqual(opsplane.validate_exposition(text), [])
+
+    def test_label_values_escape(self):
+        text = opsplane.render(
+            [("heat_tpu_session_dispatches_total", {"tenant": 'a"b\\c\nd'}, 1.0)]
+        )
+        self.assertEqual(opsplane.validate_exposition(text), [])
+        self.assertIn('tenant="a\\"b\\\\c\\nd"', text)
+
+    def test_duplicate_samples_dropped(self):
+        text = opsplane.render(
+            [
+                ("heat_tpu_session_dispatches_total", {"tenant": "x"}, 1.0),
+                ("heat_tpu_session_dispatches_total", {"tenant": "x"}, 2.0),
+            ]
+        )
+        self.assertEqual(text.count('tenant="x"'), 1)
+        self.assertIn(" 1\n", text)  # first writer wins
+
+    def test_validator_catches_malformations(self):
+        bad = (
+            "# TYPE heat_tpu_x counter\n"          # TYPE without HELP
+            "heat_tpu_x 1\n"
+            "heat_tpu_x 2\n"                        # duplicate sample
+            "heat_tpu_orphan 3\n"                   # no TYPE declaration
+            "# HELP heat_tpu_h hist\n"
+            "# TYPE heat_tpu_h histogram\n"
+            "heat_tpu_h 4\n"                        # bare histogram sample
+            "heat_tpu_x{bad labels} nope\n"         # labels + value malformed
+        )
+        problems = opsplane.validate_exposition(bad)
+        joined = "\n".join(problems)
+        self.assertIn("no preceding HELP", joined)
+        self.assertIn("duplicate sample", joined)
+        self.assertIn("no TYPE declaration", joined)
+        self.assertIn("_bucket/_sum/_count", joined)
+        self.assertIn("malformed labels", joined)
+
+
+# ----------------------------------------------------------------------
+# SLO burn-rate alerting
+# ----------------------------------------------------------------------
+class TestBurn(OpsCase):
+    def test_injected_fault_flips_fast_window_alert_and_healthz(self):
+        """The acceptance path: a synthetic latency fault breaches the SLO,
+        the two-window burn alert fires within the fast window (event +
+        finding + /metrics gauge), /healthz degrades, and the alert clears
+        once the windows drain."""
+        health_runtime.set_slo(dispatch_ms=1.0)
+        opsplane.set_burn(
+            target=0.9, fast_s=1.0, slow_s=4.0, threshold=1.0, min_samples=4
+        )
+        with telemetry.enabled(2):
+            for _ in range(16):  # 50ms >> the 1ms limit: pure budget burn
+                health_runtime._slo_observe("dispatch", 0.05)
+            opsplane.sample()
+            events = [
+                e for e in telemetry._GLOBAL.events if e["kind"] == "slo_burn"
+            ]
+        self.assertEqual(len(events), 1)
+        self.assertEqual(events[0]["metric"], "dispatch")
+        self.assertEqual(events[0]["tenant"], "*")
+        findings = opsplane.burn_findings()
+        self.assertEqual(len(findings), 1)
+        self.assertGreaterEqual(findings[0]["fast_burn"], 1.0)
+        doc = opsplane.health_status()
+        self.assertEqual(doc["status"], "degraded")
+        self.assertFalse(doc["checks"]["slo_burn"])
+        text = opsplane.render()
+        self.assertIn(
+            'heat_tpu_slo_burn_alert{metric="dispatch",tenant="*"} 1', text
+        )
+        # drain: past the fast window the burn drops and the alert clears
+        time.sleep(1.1)
+        with telemetry.enabled(2):
+            opsplane.sample()
+            clears = [
+                e for e in telemetry._GLOBAL.events
+                if e["kind"] == "slo_burn_clear"
+            ]
+        self.assertEqual(len(clears), 1)
+        self.assertEqual(opsplane.health_status()["status"], "ok")
+        self.assertIn(
+            'heat_tpu_slo_burn_alert{metric="dispatch",tenant="*"} 0',
+            opsplane.render(),
+        )
+
+    def test_per_tenant_rows_from_tagged_samples(self):
+        health_runtime.set_slo(dispatch_ms=1.0)
+        opsplane.set_burn(
+            target=0.9, fast_s=2.0, slow_s=4.0, threshold=1.0, min_samples=4
+        )
+        prev_hook = health_runtime._TENANT_HOOK
+        try:
+            health_runtime._TENANT_HOOK = lambda: "tenant-a"
+            for _ in range(8):
+                health_runtime._slo_observe("dispatch", 0.05)
+            health_runtime._TENANT_HOOK = lambda: "tenant-b"
+            for _ in range(8):
+                health_runtime._slo_observe("dispatch", 0.0001)  # in SLO
+        finally:
+            health_runtime._TENANT_HOOK = prev_hook
+        opsplane.sample()
+        alerts = opsplane.burn_report()["alerts"]
+        self.assertTrue(alerts["dispatch/tenant-a"]["active"])
+        self.assertTrue(alerts["dispatch/*"]["active"])  # half the traffic burns
+        self.assertFalse(alerts["dispatch/tenant-b"]["active"])
+
+    def test_session_latency_samples_carry_tenant(self):
+        """serving installs the _TENANT_HOOK: SLO samples recorded inside a
+        Session are tagged with the session name (the per-tenant label
+        export the burn windows group by)."""
+        self.assertIs(
+            health_runtime._TENANT_HOOK, serving._current_session_name
+        )
+        with telemetry.enabled(), serving.Session("tagged"):
+            float(ht.sum(ht.array(np.ones(8, dtype=np.float32), split=0) * 5.0))
+        tenants = {
+            s[2] for s in health_runtime._SLO_SAMPLES["dispatch"] if len(s) > 2
+        }
+        self.assertIn("tagged", tenants)
+
+    def test_no_slo_configured_no_alerts(self):
+        for _ in range(32):
+            health_runtime._slo_observe("dispatch", 10.0)
+        opsplane.sample()
+        self.assertEqual(opsplane.burn_report()["alerts"], {})
+        self.assertEqual(opsplane.health_status()["status"], "ok")
+
+
+# ----------------------------------------------------------------------
+# the ops HTTP server
+# ----------------------------------------------------------------------
+class TestServer(OpsCase):
+    def test_endpoints_roundtrip(self):
+        port = opsplane.serve(port=0)
+        code, text = _get(port, "/metrics")
+        self.assertEqual(code, 200)
+        self.assertEqual(opsplane.validate_exposition(text), [])
+        code, body = _get(port, "/healthz")
+        self.assertEqual(code, 200)
+        self.assertEqual(json.loads(body)["status"], "ok")
+        code, body = _get(port, "/readyz")
+        doc = json.loads(body)
+        # readiness tracks the mesh: this suite brings it up lazily, so pin
+        # the check against the live singleton rather than a fixed answer
+        if communication.MESH_WORLD is not None:
+            self.assertEqual((code, doc["status"]), (200, "ok"))
+        else:
+            self.assertEqual((code, doc["status"]), (503, "unready"))
+            self.assertFalse(doc["checks"]["mesh"])
+        code, body = _get(port, "/debug/report")
+        self.assertEqual(code, 200)
+        rep = json.loads(body)
+        for key in ("health", "numerics", "memory", "burn"):
+            self.assertIn(key, rep)
+        code, body = _get(port, "/debug/numerics")
+        self.assertEqual(code, 200)
+        self.assertIn("mode", json.loads(body))
+        code, body = _get(port, "/nope")
+        self.assertEqual(code, 404)
+
+    def test_debug_trace_and_flight(self):
+        with telemetry.enabled(2):
+            float(ht.sum(ht.array(np.ones(8, dtype=np.float32), split=0) * 7.0))
+            port = opsplane.serve(port=0)
+            code, body = _get(port, "/debug/trace")
+            self.assertEqual(code, 200)
+            self.assertIn("traceEvents", json.loads(body))
+            code, body = _get(port, "/debug/trace?analyze=1")
+            self.assertIn(code, (200, 409))  # 409 = window too thin to attribute
+            with tempfile.TemporaryDirectory() as d:
+                prev = health_runtime.set_dump_dir(d)
+                try:
+                    code, body = _get(port, "/debug/flight")
+                finally:
+                    health_runtime.set_dump_dir(prev)
+                self.assertEqual(code, 200)
+                doc = json.loads(body)
+                self.assertTrue(os.path.exists(doc["path"]))
+                self.assertTrue(os.path.exists(doc["trace_path"]))
+
+    def test_scrape_never_forces_a_pending_chain(self):
+        a = ht.array(np.ones(16, dtype=np.float32), split=0)
+        pending = a * 3.0 + 1.0
+        port = opsplane.serve(port=0)
+        code, _text = _get(port, "/metrics")
+        self.assertEqual(code, 200)
+        _get(port, "/debug/report")
+        self.assertTrue(
+            fusion.is_deferred(pending),
+            "an ops scrape must never force a pending chain",
+        )
+        self.assertAlmostEqual(float(ht.sum(pending)), 16 * 4.0, places=3)
+
+    def test_rearm_replaces_server_and_shutdown_disarms(self):
+        port1 = opsplane.serve(port=0)
+        port2 = opsplane.serve(port=0)
+        self.assertEqual(_get(port2, "/healthz")[0], 200)
+        self.assertTrue(opsplane.status()["armed"])
+        self.assertEqual(opsplane.status()["port"], port2)
+        opsplane.shutdown()
+        self.assertFalse(opsplane.status()["armed"])
+        with self.assertRaises(OSError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port2}/healthz", timeout=2
+            )
+        self.assertIsNotNone(port1)
+
+
+# ----------------------------------------------------------------------
+# concurrent scrapes during N=8 threaded serving sessions
+# ----------------------------------------------------------------------
+class TestConcurrentScrapes(OpsCase):
+    ROUNDS = 25
+
+    def _chain(self, arr, k):
+        return ht.sum(arr * k + 1.0)
+
+    def _input(self, seed):
+        n = (512 // self.comm.size) * self.comm.size
+        rng = np.random.default_rng(seed)
+        return ht.array(rng.normal(size=(n,)).astype(np.float32), split=0)
+
+    @pytest.mark.skipif(not fusion.active(), reason="fusion disabled")
+    def test_metrics_under_load_and_per_tenant_billing_parity(self):
+        # prebake batch-size signatures so steady state never retraces
+        for k in range(1, 9):
+            outs = [self._chain(self._input(30 + j), 1.0 + j * 0.25) for j in range(k)]
+            for o in outs:
+                float(o)
+        port = opsplane.serve(port=0)
+        barrier = threading.Barrier(9)
+        stop = threading.Event()
+        errors = []
+        scrape_stats = {"n": 0, "bad": 0}
+        sessions = {}
+
+        def client(idx):
+            try:
+                name = f"ops-client{idx}"
+                with serving.Session(name) as sess:
+                    sessions[name] = sess
+                    arr = self._input(40 + idx)
+                    barrier.wait(timeout=30)
+                    for i in range(self.ROUNDS):
+                        float(self._chain(arr, 1.0 + i * 0.25))
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        def scraper():
+            try:
+                barrier.wait(timeout=30)
+                while not stop.is_set():
+                    for route in ("/metrics", "/healthz", "/debug/report"):
+                        code, text = _get(port, route)
+                        scrape_stats["n"] += 1
+                        if code not in (200, 503):
+                            scrape_stats["bad"] += 1
+                        if route == "/metrics" and opsplane.validate_exposition(text):
+                            scrape_stats["bad"] += 1
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+        scr = threading.Thread(target=scraper)
+        for t in threads:
+            t.start()
+        scr.start()
+        for t in threads:
+            t.join(timeout=120)
+        stop.set()
+        scr.join(timeout=60)
+        self.assertEqual(errors, [])
+        self.assertGreater(scrape_stats["n"], 0, "scraper never ran")
+        self.assertEqual(scrape_stats["bad"], 0)
+        # per-tenant exposition counters == sess.report() billing, exactly
+        by_tenant = {
+            labels["tenant"]: value
+            for name, labels, value in opsplane.collect()
+            if name == "heat_tpu_session_dispatches_total"
+        }
+        for name, sess in sessions.items():
+            billed = sess.report()["stats"]["dispatches"]
+            self.assertGreater(billed, 0)
+            self.assertEqual(
+                by_tenant.get(name), float(billed),
+                f"{name}: /metrics says {by_tenant.get(name)}, "
+                f"sess.report() billed {billed}",
+            )
+
+
+# ----------------------------------------------------------------------
+# subprocess pins: env arming, never-initialize, warn-and-disarm
+# ----------------------------------------------------------------------
+class TestSubprocessPins(unittest.TestCase):
+    def _run(self, script, extra_env):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        for knob in (
+            "HEAT_TPU_FUSION", "HEAT_TPU_FAULTS", "HEAT_TPU_NUMLENS",
+            "HEAT_TPU_MEMORY_BUDGET", "HEAT_TPU_TELEMETRY",
+            "HEAT_TPU_OPS_PORT", "HEAT_TPU_METRICS",
+        ):
+            env.pop(knob, None)
+        env.update(extra_env)
+        return subprocess.run(
+            [sys.executable, "-c", script],
+            env=env, capture_output=True, text=True, timeout=240,
+        )
+
+    def test_env_port_arms_server_and_scrapes_never_initialize(self):
+        """HEAT_TPU_OPS_PORT arms the plane with the process, and a full
+        scrape of /metrics + /healthz leaves the backend untouched."""
+        script = (
+            "import json, urllib.request\n"
+            "import heat_tpu as ht\n"
+            "from heat_tpu.core import communication, opsplane\n"
+            "st = opsplane.status()\n"
+            "assert st['armed'] and st['sampling'], st\n"
+            "port = st['port']\n"
+            "for route in ('/metrics', '/healthz'):\n"
+            "    with urllib.request.urlopen(f'http://127.0.0.1:{port}{route}') as r:\n"
+            "        assert r.status == 200, (route, r.status)\n"
+            "        body = r.read().decode()\n"
+            "assert communication.MESH_WORLD is None, 'scrape initialized the backend'\n"
+            "print('PINNED ' + json.dumps({'port': port}))\n"
+        )
+        proc = self._run(script, {"HEAT_TPU_OPS_PORT": "0"})
+        self.assertEqual(proc.returncode, 0, f"{proc.stdout}\n{proc.stderr}")
+        self.assertIn("PINNED", proc.stdout)
+
+    def test_malformed_port_warns_and_disarms(self):
+        script = (
+            "import warnings\n"
+            "with warnings.catch_warnings(record=True) as w:\n"
+            "    warnings.simplefilter('always')\n"
+            "    import heat_tpu as ht\n"
+            "    from heat_tpu.core import opsplane\n"
+            "assert not opsplane.status()['armed']\n"
+            "assert any('HEAT_TPU_OPS_PORT' in str(x.message) for x in w), "
+            "[str(x.message) for x in w]\n"
+            "print('DISARMED')\n"
+        )
+        proc = self._run(script, {"HEAT_TPU_OPS_PORT": "not-a-port"})
+        self.assertEqual(proc.returncode, 0, f"{proc.stdout}\n{proc.stderr}")
+        self.assertIn("DISARMED", proc.stdout)
+
+
+# ----------------------------------------------------------------------
+# the HEAT_TPU_METRICS JSON-lines sink: stable line schema
+# ----------------------------------------------------------------------
+class TestMetricsSinkSchema(OpsCase):
+    #: the pinned top-level key set of every sink line's ``report`` —
+    #: including the post-PR 6 blocks (serving/elastic/health/numerics)
+    #: the sink used to drop when no session or hook was live
+    LINE_KEYS = {
+        "enabled", "mode", "collectives", "collective_counts",
+        "fused_collectives", "async_forcing", "forcing_points", "dispatches",
+        "unfused_reasons", "retraces", "degraded", "nonfinite", "io_retries",
+        "checkpoint", "faults", "jit_compiles", "spans", "timeline", "scopes",
+        "memory", "health", "numerics", "fusion_cache", "programs", "timers",
+        "serving", "elastic",
+    }
+
+    def test_sink_line_carries_every_block_with_no_sessions(self):
+        self.assertEqual(serving._ACTIVE, 0)  # the regression precondition
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "metrics.jsonl")
+            sink = telemetry.set_metrics_sink(path, interval=0)
+            try:
+                self.assertTrue(sink.flush("test"))
+            finally:
+                telemetry.set_metrics_sink(None)
+            with open(path) as fh:
+                lines = [json.loads(ln) for ln in fh if ln.strip()]
+        self.assertEqual(len(lines), 1)
+        line = lines[0]
+        self.assertEqual(set(line), {"ts", "event", "report"})
+        self.assertEqual(line["event"], "test")
+        self.assertEqual(
+            set(line["report"]), self.LINE_KEYS,
+            "the sink line schema moved — update LINE_KEYS deliberately "
+            "(streaming consumers pin these keys)",
+        )
+        # the once-conditional blocks are real dicts, not placeholders
+        self.assertIn("sessions", line["report"]["serving"])
+        self.assertIn("slo", line["report"]["health"])
+        self.assertIn("mode", line["report"]["numerics"])
+        self.assertIn("reforms", line["report"]["elastic"])
+
+    def test_sink_line_schema_identical_with_traffic(self):
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "metrics.jsonl")
+            sink = telemetry.set_metrics_sink(path, interval=0)
+            try:
+                with serving.Session("sinky"):
+                    float(ht.sum(ht.array(np.ones(8, dtype=np.float32), split=0)))
+                    self.assertTrue(sink.flush("busy"))
+                self.assertTrue(sink.flush("idle"))
+            finally:
+                telemetry.set_metrics_sink(None)
+            with open(path) as fh:
+                lines = [json.loads(ln) for ln in fh if ln.strip()]
+        self.assertEqual(len(lines), 2)
+        for line in lines:
+            self.assertEqual(set(line["report"]), self.LINE_KEYS)
+        names = [s["name"] for s in lines[0]["report"]["serving"]["sessions"]]
+        self.assertIn("sinky", names)
+
+
+# ----------------------------------------------------------------------
+# the CLI ops verb
+# ----------------------------------------------------------------------
+class TestCliOps(OpsCase):
+    def test_check_and_scrape_against_live_server(self):
+        import heat_tpu.telemetry as cli
+
+        float(ht.sum(ht.array(np.ones(8, dtype=np.float32), split=0) * 2.0))
+        port = opsplane.serve(port=0)
+        out = io.StringIO()
+        rc = cli.main(["ops", "check", "--port", str(port)], out=out)
+        self.assertEqual(rc, 0, out.getvalue())
+        self.assertIn("OK: /metrics parses", out.getvalue())
+        self.assertIn("OK: /healthz answers 200", out.getvalue())
+        out = io.StringIO()
+        rc = cli.main(
+            ["ops", "scrape", "--port", str(port), "--path", "/healthz"], out=out
+        )
+        self.assertEqual(rc, 0)
+        self.assertEqual(json.loads(out.getvalue())["status"], "ok")
+
+    def test_check_unreachable_endpoint_fails(self):
+        import heat_tpu.telemetry as cli
+
+        out = io.StringIO()
+        # a port from the ephemeral range with nothing bound
+        rc = cli.main(
+            ["ops", "check", "--port", "1", "--timeout", "2"], out=out
+        )
+        self.assertEqual(rc, 1)
+        self.assertIn("ERROR", out.getvalue())
+
+
+if __name__ == "__main__":
+    unittest.main()

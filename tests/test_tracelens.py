@@ -275,13 +275,15 @@ class TestStragglerAttribution(TracelensCase):
             self.assertEqual(findings[0]["severity"], "warning")
 
             # control: merging only the healthy hosts names no straggler.
-            # Concurrent worker startup adds O(10ms) scheduler jitter, so the
-            # control runs with the threshold above jitter but far below the
-            # ~180ms injected lag the main assertion detects at the default.
+            # Concurrent worker startup adds scheduler jitter — up to ~90ms
+            # on a single-core box where 4 workers' jax imports time-slice
+            # against each other's record loops — so the control runs with
+            # the threshold above that jitter but still far below the ~180ms
+            # injected lag the main assertion detects at the default.
             healthy = [p for h, p in enumerate(paths) if h != slow]
             merged2 = os.path.join(td, "healthy.json")
             telemetry.merge_traces(healthy, merged2)
-            ana2 = tracelens.analyze(merged2, straggler_ms=60.0)
+            ana2 = tracelens.analyze(merged2, straggler_ms=120.0)
             self.assertIsNone(ana2["stragglers"]["straggler"], ana2["stragglers"])
             self.assertEqual(
                 [f for f in ana2["findings"] if f["rule"] == "tracelens.straggler"], []

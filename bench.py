@@ -1056,6 +1056,147 @@ def worker() -> None:
     except Exception:  # noqa: BLE001 - diagnostics must never cost the record
         pass
 
+    # autoscale leg (core/autoscale.py, ISSUE 18): the overload-protection
+    # loop under a bursty 8-tenant mixed-tier storm with the controller armed
+    # and the burn alert lit — p99 dispatch latency the 4 interactive
+    # sessions pay while the 4 batch tiers are being shed
+    # (interactive_p99_ms_overload: the whole point of tiered shedding is
+    # that this stays flat), the fraction of batch dispatches refused while
+    # shedding was active (batch_shed_pct), and the wall time from the last
+    # overload dispatch until the controller walks shed back off and reports
+    # state "ok" (overload_recovery_ms: drain window + hysteresis cooldown).
+    # Runs AFTER the record is banked (hang-safety invariant).
+    try:
+        import threading as _as_threading
+
+        from heat_tpu.core import autoscale as _autoscale
+        from heat_tpu.core import fusion as _as_fusion
+        from heat_tpu.core import health_runtime as _as_health
+        from heat_tpu.core import opsplane as _as_ops
+        from heat_tpu.core import serving as _as_serving
+
+        if chain_fused and _as_fusion.active():
+
+            def _as_input(seed):
+                _k = jax.random.PRNGKey(seed)
+                _n = (4096 // comm.size) * comm.size
+                return ht.array(
+                    jax.device_put(
+                        jax.random.normal(_k, (_n,), dtype=jnp.float32),
+                        comm.sharding(1, 0),
+                    ),
+                    is_split=0,
+                )
+
+            def _as_p99(lats):
+                xs = sorted(lats)
+                return 1e3 * xs[min(len(xs) - 1, int(0.99 * len(xs)))]
+
+            # warm the chain shape before the storm so the measured window
+            # is dispatch latency, not first-call compiles
+            with _as_serving.Session("as-warm"):
+                _as_arr = _as_input(60)
+                for _i in range(3):
+                    float(ht.sum(_as_arr * (1.0 + _i) + 1.0))
+
+            _as_prev_slo = _as_health.set_slo(dispatch_ms=1.0)
+            _as_prev_burn = _as_ops.set_burn(
+                target=0.9, fast_s=1.0, slow_s=4.0,
+                threshold=1.0, min_samples=4,
+            )
+            try:
+                # no mesh moves in-bench: shrink_after_s parks the shrink arm
+                # so recovery measures the shed hysteresis, not a mesh reform
+                _autoscale.arm(
+                    interval_s=60.0, cooldown_s=0.3, shrink_after_s=3600.0,
+                )
+                for _ in range(16):  # light the burn alert deterministically
+                    _as_health._slo_observe("dispatch", 0.05)
+                _as_ops.sample()
+                if _autoscale.poll() != "shed_on":
+                    raise RuntimeError("controller refused to shed")
+
+                _as_barrier = _as_threading.Barrier(8)
+                _as_lats = [[] for _ in range(4)]
+                _as_ifail = []
+                _as_shed = [0]
+                _as_tries = [0]
+                _as_tally = _as_threading.Lock()
+
+                def _as_interactive(idx):
+                    with _as_serving.Session(
+                        f"as-fg{idx}", tier="interactive", deadline_ms=100.0
+                    ):
+                        arr = _as_input(70 + idx)
+                        _as_barrier.wait(timeout=60)
+                        for i in range(8):
+                            t0 = time.perf_counter()
+                            try:
+                                float(ht.sum(arr * (1.0 + i * 0.25) + 1.0))
+                            except Exception as exc:  # noqa: BLE001
+                                _as_ifail.append(exc)
+                            _as_lats[idx].append(time.perf_counter() - t0)
+
+                def _as_batch(idx):
+                    with _as_serving.Session(f"as-bg{idx}", tier="batch"):
+                        arr = _as_input(80 + idx)
+                        _as_barrier.wait(timeout=60)
+                        for i in range(8):
+                            with _as_tally:
+                                _as_tries[0] += 1
+                            try:
+                                float(ht.sum(arr * (1.0 + i * 0.25) + 1.0))
+                            except _as_serving.ShedError:
+                                with _as_tally:
+                                    _as_shed[0] += 1
+
+                _as_threads = [
+                    _as_threading.Thread(target=_as_interactive, args=(i,))
+                    for i in range(4)
+                ] + [
+                    _as_threading.Thread(target=_as_batch, args=(i,))
+                    for i in range(4)
+                ]
+                for _t in _as_threads:
+                    _t.start()
+                for _t in _as_threads:
+                    _t.join()
+                if not _as_ifail:
+                    record["interactive_p99_ms_overload"] = round(
+                        _as_p99([v for lats in _as_lats for v in lats]), 3
+                    )
+                if _as_tries[0]:
+                    record["batch_shed_pct"] = round(
+                        100.0 * _as_shed[0] / _as_tries[0], 1
+                    )
+
+                # recovery: stop injecting breaches, let the fast window
+                # drain, and time until the controller reports "ok" again
+                _as_t0 = time.perf_counter()
+                while (
+                    _autoscale.stats().get("state") != "ok"
+                    and time.perf_counter() - _as_t0 < 30.0
+                ):
+                    _as_ops.sample()
+                    _autoscale.poll()
+                    time.sleep(0.05)
+                if _autoscale.stats().get("state") == "ok":
+                    record["overload_recovery_ms"] = round(
+                        (time.perf_counter() - _as_t0) * 1e3, 1
+                    )
+            finally:
+                _autoscale.disarm(restore=True)
+                _as_serving.shed(())
+                _as_health.set_slo(
+                    dispatch_ms=None
+                    if _as_prev_slo.get("dispatch") is None
+                    else _as_prev_slo["dispatch"] * 1e3
+                )
+                _as_ops.set_burn(**_as_prev_burn)
+            print(json.dumps(record), flush=True)  # last parseable line wins
+    except Exception:  # noqa: BLE001 - diagnostics must never cost the record
+        pass
+
     # static-analysis leg (heat_tpu/analysis, ISSUE 7): the AST lint's wall
     # time over the library (the pre-commit budget a CI hook would pay) and
     # the AOT program auditor's finding count over the program cache the
@@ -1688,6 +1829,17 @@ _OPS_CEILINGS = {
     "metrics_scrape_ms": 250.0,
 }
 
+#: autoscale overload-loop ceilings: interactive p99 while batch tiers shed
+#: (tiered shedding exists to keep this flat), wall time from drain start
+#: until the controller reports "ok" (fast burn window + hysteresis
+#: cooldown), and the batch shed fraction (a percentage, hard-capped at 100);
+#: same ``max(ceiling, banked*1.5+2.0)`` noise logic as the overhead gauges
+_AUTOSCALE_CEILINGS = {
+    "interactive_p99_ms_overload": 50.0,
+    "overload_recovery_ms": 30000.0,
+    "batch_shed_pct": 100.0,
+}
+
 #: serving counters that must be EXACTLY zero — steady-state traffic never
 #: recompiles and a warm process against a populated cache dir never
 #: compiles; no noise slack applies (a retrace is a bug, not jitter)
@@ -1829,6 +1981,20 @@ def compare_records(fresh: dict, banked: dict, slack: float = 0.30) -> dict:
                 notes.append(f"{key}: banked={b:g} but missing from fresh record")
             continue
         limit = ceiling if b is None else max(ceiling, b * 1.5 + 2.0)
+        if f > limit:
+            regressions.append(
+                f"{key}: fresh {f:g} > limit {limit:g} "
+                f"(ceiling {ceiling:g}, banked {b if b is not None else 'n/a'})"
+            )
+    for key, ceiling in _AUTOSCALE_CEILINGS.items():
+        f, b = _num(fresh, key), _num(banked, key)
+        if f is None:
+            if b is not None:
+                notes.append(f"{key}: banked={b:g} but missing from fresh record")
+            continue
+        limit = ceiling if b is None else max(ceiling, b * 1.5 + 2.0)
+        if key == "batch_shed_pct":
+            limit = min(limit, 100.0)  # a percentage cannot regress past 100
         if f > limit:
             regressions.append(
                 f"{key}: fresh {f:g} > limit {limit:g} "

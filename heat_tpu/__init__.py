@@ -12,6 +12,7 @@ from . import classification, cluster, datasets, graph, naive_bayes, nn, ops, op
 from .utils import checkpoint  # ht.checkpoint — the verified sharded checkpoint subsystem
 from .core import (
     arithmetics,
+    autoscale,
     base,
     communication,
     complex_math,

@@ -1,0 +1,631 @@
+"""SLO-driven autoscaling: the control loop that closes the serving ×
+elastic × opsplane triangle (ROADMAP item 6).
+
+The runtime *measures* overload in three places — serving's admission
+buckets, opsplane's multi-window SLO burn-rate alerts, health_runtime's
+breach windows — but none of them *acts*. This module is the supervisor
+that does: a daemon poll (the ``elastic.Supervisor`` idiom) observes those
+gauges and drives three actuators:
+
+1. **Tiered load shedding** — under overload, dispatches from shed-tier
+   (``batch``/``preemptible``) sessions are refused with a typed
+   :class:`~heat_tpu.core.serving.ShedError` while interactive traffic
+   keeps its admission tokens. Refused chains stay PENDING (the
+   ``_DRAIN_EXCLUDE`` contract): never degraded, never double-dispatched —
+   they dispatch cleanly once shedding lifts.
+2. **Deadline-aware dispatch ordering** — serving installs
+   ``fusion._ROOT_PRIORITY`` so the cross-session batch window orders
+   roots by (tier, session deadline) instead of arrival; a
+   latency-sensitive root is never convoyed behind a batch tenant.
+3. **Mesh shrink/recover through the elastic seams** — sustained burn
+   triggers a shrink-style reform (drain under admission hold → probe →
+   ``communication.reform`` → guard/fault reset, a forensics bundle on
+   every action); after the burn clears and a cooldown passes, the
+   controller re-forms back to the full device set.
+
+The state machine is deliberately conservative: hysteresis (a burn must
+*persist* before the mesh moves, and must stay *clear* through a cooldown
+before recovery), a ``max_actions`` budget on mesh moves and a
+``min_devices`` floor mean the controller can never flap or scale the
+mesh out from under itself. Failure is bounded-and-loud: an
+:class:`~heat_tpu.core.elastic.ElasticError` from an actuator is counted,
+dumped and warned — the loop survives, the mesh stays where it was.
+
+Every decision lands as a telemetry event and on the
+``heat_tpu_autoscale_*`` opsplane families (via the
+``telemetry._AUTOSCALE_HOOK`` set-attribute seam installed at the bottom
+of this module); ``/readyz`` flips unready while shedding is active.
+
+Arming::
+
+    ht.autoscale.arm()                       # defaults, daemon poll
+    ht.autoscale.arm(cooldown_s=5.0, max_actions=2)
+    ht.autoscale.disarm()                    # stop + un-shed + recover
+
+or via the environment: ``HEAT_TPU_AUTOSCALE=1`` arms at import with the
+``HEAT_TPU_AUTOSCALE_*`` knobs below (malformed values warn and keep
+defaults — a broken knob never kills an import).
+"""
+
+import os
+import threading
+import time
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from . import communication, health_runtime, memledger, resilience, telemetry
+from .elastic import ElasticError, probe_devices
+
+__all__ = [
+    "Controller",
+    "arm",
+    "disarm",
+    "poll",
+    "armed",
+    "stats",
+    "status",
+    "reset",
+]
+
+
+# ----------------------------------------------------------------------
+# env knobs (warn-and-keep-default: observability/control config must
+# never crash an import)
+# ----------------------------------------------------------------------
+def _env_float(name: str, default: float, minimum: float = 0.0) -> float:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        value = float(raw)
+    except ValueError:
+        warnings.warn(
+            f"{name}={raw!r} is not a number; using {default}", stacklevel=2
+        )
+        return default
+    if value < minimum:
+        warnings.warn(
+            f"{name}={raw!r} is below the floor {minimum}; using {default}",
+            stacklevel=2,
+        )
+        return default
+    return value
+
+
+def _env_int(name: str, default: int, minimum: int = 0) -> int:
+    return int(_env_float(name, float(default), float(minimum)))
+
+
+def _env_tiers(name: str, default: Tuple[str, ...]) -> Tuple[str, ...]:
+    from . import serving
+
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    tiers = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        resolved = serving._TIER_ALIASES.get(part, part)
+        if resolved not in serving._TIERS:
+            warnings.warn(
+                f"{name}={raw!r}: unknown tier {part!r}; using {default}",
+                stacklevel=2,
+            )
+            return default
+        tiers.append(resolved)
+    return tuple(tiers) or default
+
+
+def _defaults() -> Dict[str, Any]:
+    return {
+        "interval_s": _env_float("HEAT_TPU_AUTOSCALE_INTERVAL_S", 1.0, 0.01),
+        "cooldown_s": _env_float("HEAT_TPU_AUTOSCALE_COOLDOWN_S", 30.0),
+        "shrink_after_s": _env_float("HEAT_TPU_AUTOSCALE_SHRINK_AFTER_S", 10.0),
+        "max_actions": _env_int("HEAT_TPU_AUTOSCALE_MAX_ACTIONS", 4),
+        "min_devices": _env_int("HEAT_TPU_AUTOSCALE_MIN_DEVICES", 1, 1),
+        "shrink_n": _env_int("HEAT_TPU_AUTOSCALE_SHRINK", 1),
+        "shed_tiers": _env_tiers("HEAT_TPU_AUTOSCALE_SHED_TIERS", ("batch",)),
+    }
+
+
+# ----------------------------------------------------------------------
+# the controller
+# ----------------------------------------------------------------------
+class Controller:
+    """One process's overload supervisor: observe → decide → act.
+
+    States: ``ok`` → (burn rising edge) → ``shedding`` → (burn persists
+    ``shrink_after_s``) → ``shrunk`` → (burn clear for ``cooldown_s``) →
+    ``ok`` (shed lifted, mesh re-formed to the full set). The burn
+    re-rising during the cooldown restarts it — the only path back to
+    ``ok`` is a *sustained* clear.
+
+    Parameters
+    ----------
+    interval_s : float
+        Daemon poll cadence; :func:`opsplane.on_burn` edges wake the loop
+        early so reaction is event-driven, the poll is the fallback.
+    cooldown_s : float
+        How long the burn must stay clear before shedding lifts and the
+        mesh recovers (the anti-flap hysteresis on the way down).
+    shrink_after_s : float
+        How long shedding alone must fail to clear the burn before the
+        controller moves the mesh (the hysteresis on the way up).
+    max_actions : int
+        Budget on mesh moves (shrink + recover both count). When spent,
+        the controller keeps shedding but the mesh holds still — a
+        ``bound`` decision is recorded once per saturation.
+    min_devices : int
+        Floor under the shrunken mesh; a shrink that would cross it is
+        refused (``ElasticError``, counted + dumped, loop survives).
+    shrink_n : int
+        Tail devices shed per shrink action.
+    shed_tiers : sequence of str
+        Tiers flipped to shed under overload (default ``("batch",)``).
+    """
+
+    def __init__(
+        self,
+        interval_s: float = 1.0,
+        cooldown_s: float = 30.0,
+        shrink_after_s: float = 10.0,
+        max_actions: int = 4,
+        min_devices: int = 1,
+        shrink_n: int = 1,
+        shed_tiers: Sequence[str] = ("batch",),
+    ) -> None:
+        from . import elastic, serving
+
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        if min_devices < 1:
+            raise ValueError(f"min_devices must be >= 1, got {min_devices}")
+        self.interval_s = float(interval_s)
+        self.cooldown_s = max(0.0, float(cooldown_s))
+        self.shrink_after_s = max(0.0, float(shrink_after_s))
+        self.max_actions = max(0, int(max_actions))
+        self.min_devices = int(min_devices)
+        self.shrink_n = max(0, int(shrink_n))
+        self.drain_ms = elastic._parse_drain_ms()
+        resolved = []
+        for t in shed_tiers:
+            t = serving._TIER_ALIASES.get(t, t)
+            if t not in serving._TIERS:
+                raise ValueError(
+                    f"unknown tier {t!r}: tiers are {serving._TIERS} "
+                    f"(alias {tuple(serving._TIER_ALIASES)})"
+                )
+            resolved.append(t)
+        self.shed_tiers: Tuple[str, ...] = tuple(resolved)
+
+        self._lock = threading.RLock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._unsubscribe: Optional[Callable[[], None]] = None
+
+        self.state = "ok"
+        self._burn_since: Optional[float] = None  # monotonic, rising edge
+        self._clear_since: Optional[float] = None  # monotonic, falling edge
+        self._baseline: Optional[int] = None  # mesh size before first shrink
+        self._shrunk = False
+        self._bound_noted = False
+        self.mesh_actions = 0
+        self.ticks = 0
+        self.burn_edges = 0
+        self.decisions: Dict[str, int] = {
+            "shed_on": 0,
+            "shed_off": 0,
+            "shrink": 0,
+            "recover": 0,
+            "bound": 0,
+            "errors": 0,
+        }
+        self.last_decision: Optional[Dict[str, Any]] = None
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        """Arm: subscribe to burn edges and start the daemon poll."""
+        from . import opsplane
+
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._stop.clear()
+            self._unsubscribe = opsplane.on_burn(self._on_burn)
+            self._thread = threading.Thread(
+                target=self._run, name="heat-tpu-autoscale", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self, restore: bool = True) -> None:
+        """Disarm: stop the poll, unsubscribe, lift shedding and (with
+        ``restore``) re-form a shrunken mesh back to the full set. A
+        failing restore is bounded-and-loud (counted + warned), never
+        raised out of the disarm."""
+        with self._lock:
+            thread, self._thread = self._thread, None
+            unsub, self._unsubscribe = self._unsubscribe, None
+        self._stop.set()
+        self._wake.set()
+        if unsub is not None:
+            unsub()
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=max(5.0, 2 * self.interval_s))
+        with self._lock:
+            obs = {"reason": "disarm"}
+            if self.state != "ok":
+                self._act_shed_off(obs)
+            if restore and self._shrunk:
+                try:
+                    self._act_recover(obs)
+                except ElasticError as exc:
+                    self._note_error("recover", exc, obs)
+            self.state = "ok"
+            self._burn_since = None
+            self._clear_since = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self.interval_s)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self.tick()
+            # the loop is the protection: it must survive anything an
+            # actuator or observer throws
+            # heat-lint: disable=H003 — supervisor loops never die
+            except Exception as exc:  # noqa: BLE001
+                self.decisions["errors"] += 1
+                warnings.warn(f"autoscale tick failed: {exc!r}", stacklevel=2)
+
+    def _on_burn(self, metric: str, tenant: str, rising: bool, snapshot) -> None:
+        """:func:`opsplane.on_burn` subscriber — runs on the ticking
+        thread after the burn lock released; just wakes the loop so the
+        decision (which may drain + reform) happens on our own thread."""
+        self.burn_edges += 1
+        self._wake.set()
+
+    # -- observe --------------------------------------------------------
+    def _observe(self) -> Dict[str, Any]:
+        """Snapshot the three gauge families the controller consumes:
+        active burn alerts, SLO breach fractions and the projected global
+        admission tokens. Each read is independently fault-isolated."""
+        from . import opsplane, serving
+
+        obs: Dict[str, Any] = {"burn": [], "breach": {}, "tokens": None}
+        try:
+            alerts = opsplane.burn_report()["alerts"]
+            obs["burn"] = sorted(
+                key for key, row in alerts.items() if row.get("active")
+            )
+        except Exception:  # noqa: BLE001 - a broken gauge never stops the loop
+            pass
+        for metric in ("dispatch", "sync", "compile"):
+            try:
+                frac = health_runtime.breach_fraction(metric)
+            except Exception:  # noqa: BLE001
+                frac = None
+            if frac is not None:
+                obs["breach"][metric] = round(frac, 4)
+        try:
+            with serving._LOCK:
+                bucket = serving._GLOBAL_BUCKET
+            if bucket is not None:
+                from .opsplane import _bucket_tokens
+
+                obs["tokens"] = round(_bucket_tokens(bucket), 3)
+        except Exception:  # noqa: BLE001
+            pass
+        return obs
+
+    # -- decide ---------------------------------------------------------
+    def tick(self, now: Optional[float] = None) -> Optional[str]:
+        """One observe → decide → act pass. Returns the action taken (or
+        None). Thread-safe; tests drive it directly via :func:`poll`."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self.ticks += 1
+            obs = self._observe()
+            overloaded = bool(obs["burn"])
+            if overloaded:
+                self._clear_since = None
+                if self._burn_since is None:
+                    self._burn_since = now
+                if self.state == "ok":
+                    self._act_shed_on(obs)
+                    self.state = "shedding"
+                    return "shed_on"
+                if (
+                    self.state == "shedding"
+                    and now - self._burn_since >= self.shrink_after_s
+                ):
+                    return self._try_shrink(obs)
+                return None
+            # burn clear: hysteresis on the way down
+            self._burn_since = None
+            if self.state == "ok":
+                return None
+            if self._clear_since is None:
+                self._clear_since = now
+            if now - self._clear_since < self.cooldown_s:
+                return None
+            action = None
+            if self._shrunk:
+                try:
+                    self._act_recover(obs)
+                    action = "recover"
+                except ElasticError as exc:
+                    self._note_error("recover", exc, obs)
+                    return None  # keep shedding; retry next cooldown tick
+            self._act_shed_off(obs)
+            self.state = "ok"
+            self._clear_since = None
+            return action or "shed_off"
+
+    def _try_shrink(self, obs: Dict[str, Any]) -> Optional[str]:
+        comm = communication.MESH_WORLD
+        if comm is None:
+            # the controller never initializes the backend itself
+            return None
+        devices = list(comm.devices)
+        lose = max(0, min(self.shrink_n, len(devices) - self.min_devices))
+        if lose == 0:
+            return None  # already at (or below) the floor
+        if self.mesh_actions >= self.max_actions:
+            if not self._bound_noted:
+                self._bound_noted = True
+                self._decide(
+                    "bound", obs, mesh_actions=self.mesh_actions,
+                    max_actions=self.max_actions,
+                )
+            return None
+        try:
+            self._act_shrink(obs, devices, lose)
+        except ElasticError as exc:
+            self._note_error("shrink", exc, obs)
+            return None
+        self.state = "shrunk"
+        return "shrink"
+
+    # -- act ------------------------------------------------------------
+    def _act_shed_on(self, obs: Dict[str, Any]) -> None:
+        from . import serving
+
+        serving.shed(self.shed_tiers)
+        self._decide("shed_on", obs, tiers=list(self.shed_tiers))
+
+    def _act_shed_off(self, obs: Dict[str, Any]) -> None:
+        from . import serving
+
+        serving.shed(())
+        self._decide("shed_off", obs)
+
+    def _reform(self, survivors: Optional[List], reason: str) -> None:
+        """The elastic reform ritual under an admission hold: drain every
+        pending root (gate-exempt, watchdog-bounded), probe, re-form,
+        reset guards + the device-fault ledger. ``survivors=None``
+        restores the full live device set."""
+        from . import fusion
+
+        with memledger.admission_hold(reason):
+            with memledger.gate_exempt():
+                with health_runtime.watch(
+                    "autoscale:drain", deadline_ms=self.drain_ms
+                ):
+                    fusion._drain_pending_roots(())
+            if survivors is not None:
+                survivors = probe_devices(survivors)
+                if len(survivors) < self.min_devices:
+                    raise ElasticError(
+                        f"only {len(survivors)} healthy device(s) would "
+                        f"survive the shrink (min_devices={self.min_devices})"
+                    )
+            communication.reform(survivors)
+            health_runtime.reset_guards()
+            resilience.reset_device_faults()
+
+    def _act_shrink(self, obs: Dict[str, Any], devices: List, lose: int) -> None:
+        if self._baseline is None:
+            self._baseline = len(devices)
+        self._reform(
+            devices[: len(devices) - lose],
+            f"autoscale shrink: sustained SLO burn ({obs['burn']})",
+        )
+        self._shrunk = True
+        self.mesh_actions += 1
+        self._decide(
+            "shrink", obs, lose=lose, devices=len(devices) - lose,
+            baseline=self._baseline,
+        )
+        health_runtime.auto_dump("autoscale_shrink")
+
+    def _act_recover(self, obs: Dict[str, Any]) -> None:
+        self._reform(None, "autoscale recover: burn clear through cooldown")
+        self._shrunk = False
+        self._bound_noted = False
+        self.mesh_actions += 1
+        comm = communication.MESH_WORLD
+        self._decide(
+            "recover", obs,
+            devices=0 if comm is None else len(comm.devices),
+            baseline=self._baseline,
+        )
+        health_runtime.auto_dump("autoscale_recover")
+
+    # -- bookkeeping ----------------------------------------------------
+    def _decide(self, action: str, obs: Dict[str, Any], **fields) -> None:
+        self.decisions[action] = self.decisions.get(action, 0) + 1
+        rec = {"action": action, "ts": time.time(), "obs": dict(obs)}
+        rec.update(fields)
+        self.last_decision = rec
+        telemetry.record_event("autoscale_" + action, **{
+            k: v for k, v in rec.items() if k not in ("ts",)
+        })
+
+    def _note_error(self, action: str, exc: Exception, obs: Dict[str, Any]) -> None:
+        """Bounded-and-loud actuator failure: counted, evented, dumped,
+        warned — never raised out of the loop."""
+        self.decisions["errors"] += 1
+        telemetry.record_event(
+            "autoscale_error", action=action, error=str(exc), obs=dict(obs)
+        )
+        health_runtime.auto_dump("autoscale_" + action + "_failed")
+        warnings.warn(f"autoscale {action} failed: {exc}", stacklevel=3)
+
+    def snapshot(self) -> Dict[str, Any]:
+        from . import serving
+
+        comm = communication.MESH_WORLD  # never initialize the backend here
+        with self._lock:
+            return {
+                "armed": self._thread is not None,
+                "state": self.state,
+                "shedding": self.state != "ok",
+                "shed_tiers": sorted(serving._SHED_TIERS),
+                "decisions": dict(self.decisions),
+                "mesh_actions": self.mesh_actions,
+                "max_actions": self.max_actions,
+                "min_devices": self.min_devices,
+                "mesh": {
+                    "devices": 0 if comm is None else len(comm.devices),
+                    "baseline": self._baseline,
+                },
+                "shed_refusals": serving._SHED_STATS["refusals"],
+                "ticks": self.ticks,
+                "burn_edges": self.burn_edges,
+                "last_decision": self.last_decision,
+            }
+
+
+# ----------------------------------------------------------------------
+# the module-level singleton
+# ----------------------------------------------------------------------
+_CONTROLLER: Optional[Controller] = None
+_LOCK = threading.Lock()
+
+
+def arm(**overrides) -> Controller:
+    """Arm the autoscale controller (idempotent per-process singleton:
+    re-arming replaces the previous controller, stopping it first). Any
+    :class:`Controller` kwarg overrides its ``HEAT_TPU_AUTOSCALE_*``
+    default. Returns the armed controller."""
+    global _CONTROLLER
+    cfg = _defaults()
+    cfg.update(overrides)
+    ctl = Controller(**cfg)
+    with _LOCK:
+        prev, _CONTROLLER = _CONTROLLER, ctl
+    if prev is not None:
+        prev.stop()
+    ctl.start()
+    return ctl
+
+
+def disarm(restore: bool = True) -> None:
+    """Stop the controller: lift shedding, re-form a shrunken mesh back
+    to the full set (unless ``restore=False``), drop the subscription."""
+    global _CONTROLLER
+    with _LOCK:
+        ctl, _CONTROLLER = _CONTROLLER, None
+    if ctl is not None:
+        ctl.stop(restore=restore)
+
+
+def armed() -> bool:
+    """True while a controller is armed."""
+    ctl = _CONTROLLER
+    return ctl is not None and ctl._thread is not None
+
+
+def poll() -> Optional[str]:
+    """Run one controller tick on the calling thread (tests and manual
+    drivers; the armed daemon keeps its own cadence). Returns the action
+    taken, or None — also None when nothing is armed."""
+    ctl = _CONTROLLER
+    return None if ctl is None else ctl.tick()
+
+
+def stats() -> Dict[str, Any]:
+    """The controller snapshot — the shape behind
+    ``telemetry._AUTOSCALE_HOOK`` (so ``report()["autoscale"]`` and the
+    ``heat_tpu_autoscale_*`` opsplane families read one source). Armed or
+    not, this never initializes the backend."""
+    ctl = _CONTROLLER
+    if ctl is not None:
+        return ctl.snapshot()
+    from . import serving
+
+    comm = communication.MESH_WORLD
+    return {
+        "armed": False,
+        "state": "disarmed",
+        "shedding": bool(serving._SHED_TIERS),
+        "shed_tiers": sorted(serving._SHED_TIERS),
+        "decisions": {},
+        "mesh_actions": 0,
+        "max_actions": 0,
+        "min_devices": 1,
+        "mesh": {"devices": 0 if comm is None else len(comm.devices),
+                 "baseline": None},
+        "shed_refusals": serving._SHED_STATS["refusals"],
+        "ticks": 0,
+        "burn_edges": 0,
+        "last_decision": None,
+    }
+
+
+def status() -> Dict[str, Any]:
+    """Operator view: the :func:`stats` snapshot plus the armed
+    controller's configuration."""
+    doc = stats()
+    ctl = _CONTROLLER
+    if ctl is not None:
+        doc["config"] = {
+            "interval_s": ctl.interval_s,
+            "cooldown_s": ctl.cooldown_s,
+            "shrink_after_s": ctl.shrink_after_s,
+            "max_actions": ctl.max_actions,
+            "min_devices": ctl.min_devices,
+            "shrink_n": ctl.shrink_n,
+            "shed_tiers": list(ctl.shed_tiers),
+        }
+    return doc
+
+
+def reset() -> None:
+    """Disarm and forget the controller (``telemetry.reset()`` cascades
+    here so no test leaks a daemon poll or an armed shed set into the
+    next). The mesh is NOT re-formed — reset is bookkeeping, not an
+    actuator; a shrunken mesh recovers via :func:`disarm` or the next
+    armed controller."""
+    global _CONTROLLER
+    with _LOCK:
+        ctl, _CONTROLLER = _CONTROLLER, None
+    if ctl is not None:
+        ctl.stop(restore=False)
+
+
+# report()["autoscale"] + the opsplane collector read this one snapshot
+# (set-attribute seam: telemetry never imports this module)
+telemetry._AUTOSCALE_HOOK = stats
+
+
+# env arming: HEAT_TPU_AUTOSCALE truthy -> the controller comes up with
+# the process (warn-and-disarm: a bad knob combination must never die at
+# import)
+_raw = os.environ.get("HEAT_TPU_AUTOSCALE", "").strip().lower()
+if _raw not in ("", "0", "false", "off", "no"):  # pragma: no cover - env path
+    try:
+        arm()
+    # heat-lint: disable=H003 — env arming is best-effort by contract
+    except Exception as _exc:  # noqa: BLE001
+        warnings.warn(
+            f"HEAT_TPU_AUTOSCALE={_raw!r}: arming failed ({_exc}); "
+            "the autoscaler stays disarmed",
+            stacklevel=2,
+        )
+del _raw

@@ -435,6 +435,17 @@ _DISK_INDEX = None  # persistent program-key index: disk warm-start accounting
 _ADMIT_HOOK = None
 _SERVING_NOTE = None  # per-session incident/billing notes
 _SESSION_OF = None  # resolves the calling thread's active Session id
+# dispatch-ordering seam: maps a root's recording session name to a sort
+# key (serving installs (tier_rank, deadline_ms)). When set, _gather_batch
+# considers candidates in (priority, registration) order instead of pure
+# registration order, so interactive/deadline-near roots win batch slots
+# over batch-tier chains. Must be deterministic — candidate order feeds the
+# program signature, and nondeterminism would churn the program cache.
+# The hook may also return _BATCH_EXCLUDED to keep a root OUT of other
+# sessions' gathered batches entirely (serving: shed-tier roots must not
+# free-ride an interactive neighbour's dispatch while shedding is active).
+_ROOT_PRIORITY = None
+_BATCH_EXCLUDED = object()
 
 # micro batch window (seconds): when serving arms this (>= 2 concurrent
 # sessions), a top-level force sleeps this long BEFORE taking _FORCE_LOCK.
@@ -656,8 +667,30 @@ def _gather_batch(entries, leaves, memo, roots):
                 break
     if device_set is None:
         return  # no placed operand to anchor the mesh: skip batching
+    keys = _live_root_keys()
+    prio = _ROOT_PRIORITY
+    if prio is not None:
+        # deadline/tier-aware ordering (serving's seam): candidates sort by
+        # (priority, registration key) — deterministic for a given session
+        # mix, so repeated steady-state batches keep one program signature.
+        # _BATCH_EXCLUDED candidates (shed tiers) drop out entirely: a shed
+        # root riding a neighbour's batch would dispatch work the overload
+        # controller just refused.
+        ranked = []
+        for key in keys:
+            wrapper = _LIVE_ROOTS.get(key)
+            payload = getattr(wrapper, "_payload", None)
+            try:
+                p = prio(getattr(payload, "session", None))
+            except Exception:  # noqa: BLE001 - ordering must never break a force
+                p = None
+            if p is _BATCH_EXCLUDED:
+                continue
+            ranked.append(((1, float("inf")) if p is None else p, key))
+        ranked.sort()
+        keys = [key for _, key in ranked]
     stale = []
-    for key in _live_root_keys():
+    for key in keys:
         if len(roots) >= _BATCH_MAX:
             break
         wrapper = _LIVE_ROOTS.get(key)

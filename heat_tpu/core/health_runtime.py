@@ -76,6 +76,7 @@ __all__ = [
     "StallError",
     "StallWarning",
     "auto_dump",
+    "breach_fraction",
     "dump_flight",
     "flight_events",
     "flight_stats",
@@ -550,6 +551,35 @@ def set_slo(
         global _SLO_WINDOW_S
         _SLO_WINDOW_S = max(1.0, float(window_s))
     return prev
+
+
+def breach_fraction(
+    metric: str,
+    window_s: Optional[float] = None,
+    tenant: Optional[str] = None,
+) -> Optional[float]:
+    """The fraction of SLO samples for ``metric`` inside the trailing
+    ``window_s`` (default: the rolling SLO window) that breached the
+    configured limit, optionally restricted to one ``tenant``. Returns
+    ``None`` when no SLO is set or no samples land in the window — the
+    autoscaler's direct p99-pressure read, cheaper than a full
+    ``_slo_block`` and tenant-selective where the block is not. Pure
+    module state: never forces, never initializes a backend."""
+    limit = _SLO_LIMITS.get(metric)
+    if limit is None:
+        return None
+    window = _SLO_WINDOW_S if window_s is None else max(0.0, float(window_s))
+    now = time.perf_counter()
+    n = bad = 0
+    for item in list(_SLO_SAMPLES.get(metric, ())):
+        if now - item[0] > window:
+            continue
+        if tenant is not None and (item[2] if len(item) > 2 else None) != tenant:
+            continue
+        n += 1
+        if item[1] > limit:
+            bad += 1
+    return (bad / n) if n else None
 
 
 def _slo_block() -> Dict[str, Any]:

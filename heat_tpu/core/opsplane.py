@@ -74,6 +74,7 @@ __all__ = [
     "sample",
     "series",
     "set_burn",
+    "on_burn",
     "burn_report",
     "burn_findings",
     "health_status",
@@ -206,6 +207,13 @@ SCHEMA: "OrderedDict[str, Dict[str, Any]]" = OrderedDict(
         ("heat_tpu_admission_tokens", (_G, "Projected tokens available, by bucket.", ["bucket"])),
         ("heat_tpu_admission_admitted_total", (_C, "Dispatches admitted, by bucket.", ["bucket"])),
         ("heat_tpu_admission_refused_total", (_C, "Dispatches refused, by bucket.", ["bucket"])),
+        # -- autoscale controller (ROADMAP item 6: the closed loop) ----
+        ("heat_tpu_autoscale_armed", (_G, "1 while the autoscale controller is armed.", [])),
+        ("heat_tpu_autoscale_shedding", (_G, "1 while tiered load shedding is active.", [])),
+        ("heat_tpu_autoscale_mesh_devices", (_G, "Devices in the current (possibly shrunk) mesh.", [])),
+        ("heat_tpu_autoscale_mesh_baseline", (_G, "Devices in the full pre-shrink mesh.", [])),
+        ("heat_tpu_autoscale_decisions_total", (_C, "Controller decisions, by action.", ["action"])),
+        ("heat_tpu_autoscale_shed_refusals_total", (_C, "Dispatches shed from shed-tier sessions.", [])),
     ]
 )
 
@@ -228,6 +236,7 @@ _INCIDENT_KINDS = (
     ("quarantine_hits", "quarantine_hit"),
     ("mem_refused", "mem_refused"),
     ("admission_refused", "admission_refused"),
+    ("shed", "shed"),
 )
 
 
@@ -399,6 +408,32 @@ def _collect_serving(out: List[Sample]) -> None:
             _bucket_samples(out, f"session:{sess.name}", sess.bucket)
 
 
+def _collect_autoscale(out: List[Sample]) -> None:
+    # set-attribute hook (the _ELASTIC_HOOK pattern): core/autoscale.py
+    # installs its stats() on telemetry at import, so this module never
+    # imports the controller that imports it back
+    hook = telemetry._AUTOSCALE_HOOK
+    if hook is None:
+        return
+    st = hook()
+    out.append(("heat_tpu_autoscale_armed", {}, 1.0 if st.get("armed") else 0.0))
+    out.append(
+        ("heat_tpu_autoscale_shedding", {}, 1.0 if st.get("shedding") else 0.0)
+    )
+    mesh = st.get("mesh") or {}
+    if mesh.get("devices"):
+        out.append(("heat_tpu_autoscale_mesh_devices", {}, float(mesh["devices"])))
+    if mesh.get("baseline"):
+        out.append(("heat_tpu_autoscale_mesh_baseline", {}, float(mesh["baseline"])))
+    for action, n in sorted((st.get("decisions") or {}).items()):
+        out.append(
+            ("heat_tpu_autoscale_decisions_total", {"action": str(action)}, float(n))
+        )
+    out.append(
+        ("heat_tpu_autoscale_shed_refusals_total", {}, float(st.get("shed_refusals", 0)))
+    )
+
+
 def _collect_burn(out: List[Sample]) -> None:
     with _BURN_LOCK:
         for (metric, tenant), row in _ALERTS.items():
@@ -439,6 +474,7 @@ _COLLECTORS = (
     _collect_numerics,
     _collect_elastic,
     _collect_serving,
+    _collect_autoscale,
 )
 
 
@@ -467,6 +503,7 @@ _OPS_STATS = {
     "collect_errors": 0,
     "series_dropped": 0,
     "sample_ms": 0.0,
+    "callback_errors": 0,
 }
 _SCRAPES: Dict[str, int] = {}
 
@@ -564,6 +601,65 @@ _ALERTS: "OrderedDict[Tuple[str, str], Dict[str, Any]]" = OrderedDict()
 _FINDINGS: deque = deque(maxlen=256)
 #: alert rows kept (newest-touched win) — bounded like the tenant labels
 _ALERT_CAP = 256
+#: burn-edge subscribers (:func:`on_burn`): called as
+#: ``callback(metric, tenant, rising, snapshot)`` AFTER ``_BURN_LOCK`` is
+#: released — a subscriber may safely read ``burn_report()`` or flip
+#: actuators without deadlocking the tick that notified it
+_BURN_CALLBACKS: List = []
+
+
+def on_burn(callback) -> Any:
+    """Subscribe ``callback(metric, tenant, rising, snapshot)`` to burn
+    alert edges: ``rising=True`` on every ``slo_burn`` firing edge,
+    ``False`` on the matching clear. ``snapshot`` is a copy of the alert
+    row at the edge. Callbacks run on the ticking thread (the sampler, a
+    scrape, or a direct :func:`sample` call) after the burn lock is
+    released; one raising subscriber never breaks the tick or the others
+    (errors are counted, not propagated). The flight recorder logs every
+    dispatch as a ``burn_callback`` event. Returns an unsubscribe
+    callable — the autoscaler holds it for its disarm path. Subscriptions
+    are configuration: they survive :func:`reset`."""
+    if not callable(callback):
+        raise TypeError(f"on_burn needs a callable, got {type(callback).__name__}")
+    with _BURN_LOCK:
+        _BURN_CALLBACKS.append(callback)
+
+    def _unsubscribe() -> None:
+        with _BURN_LOCK:
+            try:
+                _BURN_CALLBACKS.remove(callback)
+            except ValueError:  # already unsubscribed: idempotent
+                pass
+
+    return _unsubscribe
+
+
+def _dispatch_burn_edges(edges: List[Tuple[str, str, bool, Dict[str, Any]]]) -> None:
+    """Fan each accumulated edge out to the subscribers — called by
+    ``_burn_tick`` AFTER ``_BURN_LOCK`` is released, so a callback reading
+    ``burn_report()`` (or running a whole autoscale decision) cannot
+    deadlock against the tick that produced the edge."""
+    if not edges:
+        return
+    with _BURN_LOCK:
+        callbacks = list(_BURN_CALLBACKS)
+    if not callbacks:
+        return
+    for metric, tenant, rising, snapshot in edges:
+        for cb in callbacks:
+            try:
+                cb(metric, tenant, rising, dict(snapshot))
+                # the flight ring logs every dispatch (record_event lands
+                # on the ring at any active telemetry mode)
+                telemetry.record_event(
+                    "burn_callback",
+                    metric=metric,
+                    tenant=tenant,
+                    rising=rising,
+                    callback=getattr(cb, "__name__", type(cb).__name__),
+                )
+            except Exception:  # noqa: BLE001 - one subscriber never breaks a tick
+                _OPS_STATS["callback_errors"] += 1
 
 
 def set_burn(
@@ -596,8 +692,11 @@ def set_burn(
 def _burn_tick(now: Optional[float] = None) -> None:
     """Fold the tenant-tagged SLO sample windows into burn rates and run
     the two-window alert state machine. Rising edges emit ``slo_burn``
-    events + findings; falling edges emit ``slo_burn_clear``."""
+    events + findings; falling edges emit ``slo_burn_clear``. Edges are
+    accumulated under ``_BURN_LOCK`` and fanned out to :func:`on_burn`
+    subscribers only after it is released."""
     now = time.perf_counter() if now is None else now
+    edges: List[Tuple[str, str, bool, Dict[str, Any]]] = []
     with _BURN_LOCK:
         fast_s, slow_s = _BURN["fast_s"], _BURN["slow_s"]
         budget = max(1e-9, 1.0 - _BURN["target"])
@@ -648,18 +747,26 @@ def _burn_tick(now: Optional[float] = None) -> None:
                 state.update(
                     fast_burn=fast_burn, slow_burn=slow_burn, fast_n=fn, slow_n=sn
                 )
-                _edge(state, metric, tenant, firing)
+                _edge(state, metric, tenant, firing, edges)
         # rows that emptied out (no samples left in the slow window) clear
         for key, state in _ALERTS.items():
             if key in touched:
                 continue
             state.update(fast_burn=0.0, slow_burn=0.0, fast_n=0, slow_n=0)
-            _edge(state, key[0], key[1], False)
+            _edge(state, key[0], key[1], False, edges)
+    _dispatch_burn_edges(edges)
 
 
-def _edge(state: Dict[str, Any], metric: str, tenant: str, firing: bool) -> None:
+def _edge(
+    state: Dict[str, Any],
+    metric: str,
+    tenant: str,
+    firing: bool,
+    edges: List[Tuple[str, str, bool, Dict[str, Any]]],
+) -> None:
     """One alert edge under ``_BURN_LOCK``: event + finding on rise, event
-    on clear; no-op while the level holds."""
+    on clear; no-op while the level holds. Each edge is also appended to
+    ``edges`` for post-lock subscriber dispatch."""
     if firing and not state["active"]:
         state["active"] = True
         state["since"] = time.time()
@@ -679,6 +786,7 @@ def _edge(state: Dict[str, Any], metric: str, tenant: str, firing: bool) -> None
         telemetry.record_event(
             "slo_burn", **{k: v for k, v in finding.items() if k not in ("kind", "ts")}
         )
+        edges.append((metric, tenant, True, dict(state)))
     elif state["active"] and not firing:
         state["active"] = False
         telemetry.record_event(
@@ -688,6 +796,7 @@ def _edge(state: Dict[str, Any], metric: str, tenant: str, firing: bool) -> None
             fast_burn=round(state["fast_burn"], 4),
             slow_burn=round(state["slow_burn"], 4),
         )
+        edges.append((metric, tenant, False, dict(state)))
 
 
 def burn_report() -> Dict[str, Any]:
@@ -941,6 +1050,14 @@ def ready_status() -> Dict[str, Any]:
     except Exception:  # pragma: no cover - import-order safety only
         pass
     checks["admission"] = admission_ok
+    shedding_ok = True
+    try:
+        from . import serving
+
+        shedding_ok = not serving._SHED_TIERS
+    except Exception:  # pragma: no cover - import-order safety only
+        pass
+    checks["shedding"] = shedding_ok
     return {
         "status": "ok" if all(checks.values()) else "unready",
         "checks": checks,
@@ -1164,7 +1281,12 @@ def reset() -> None:
         _ALERTS.clear()
         _FINDINGS.clear()
     _OPS_STATS.update(
-        samples=0, scrape_errors=0, collect_errors=0, series_dropped=0, sample_ms=0.0
+        samples=0,
+        scrape_errors=0,
+        collect_errors=0,
+        series_dropped=0,
+        sample_ms=0.0,
+        callback_errors=0,
     )
     _SCRAPES.clear()
 

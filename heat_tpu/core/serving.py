@@ -64,12 +64,15 @@ from . import fusion, health_runtime, memledger, numlens, resilience, telemetry
 
 __all__ = [
     "AdmissionError",
+    "ShedError",
     "Session",
     "arm_cache",
     "cache_stats",
     "sessions_block",
     "session_reports",
     "set_admission",
+    "shed",
+    "shed_state",
     "warmup",
     "reset",
 ]
@@ -80,6 +83,14 @@ class AdmissionError(RuntimeError):
     ``raise`` policy. The message names the session and the bucket
     (``global`` or ``session:<name>``) that refused; the chain it refused
     is untouched — still pending, dispatchable once tokens refill."""
+
+
+class ShedError(AdmissionError):
+    """A fused dispatch from a shed tier was refused by overload
+    protection (:func:`shed`, normally flipped by ``ht.autoscale``). Same
+    containment contract as every admission refusal: the chain is still
+    pending, never degraded, never double-dispatched — it dispatches
+    cleanly (or rides a neighbour's batch) once shedding lifts."""
 
 
 # ----------------------------------------------------------------------
@@ -124,6 +135,20 @@ class _TokenBucket:
         with self._lock:
             self.tokens = min(self.burst, self.tokens + 1.0)
             self.admitted -= 1
+
+    def reconfigure(self, rate: float, burst: float) -> None:
+        """Hot-update ``rate``/``burst`` mid-traffic without losing state:
+        the ``admitted``/``refused``/``waited_s`` counters survive, and the
+        accumulated tokens are first refilled at the OLD rate up to now,
+        then clamped to the new burst — a shrink mid-burst takes effect
+        immediately instead of granting the old depth one more time."""
+        with self._lock:
+            now = time.monotonic()
+            self.tokens = min(self.burst, self.tokens + (now - self.ts) * self.rate)
+            self.ts = now
+            self.rate = float(rate)
+            self.burst = max(1.0, float(burst))
+            self.tokens = min(self.burst, self.tokens)
 
     def refuse(self) -> None:
         with self._lock:
@@ -312,6 +337,17 @@ _XLA_PREV_CONFIG = None  # jax cache config to restore on disarm_cache()
 
 _GLOBAL_BUCKET: Optional[_TokenBucket] = None
 _POLICY = _parse_env_policy()
+
+#: session tiers: ``interactive`` keeps its tokens under overload;
+#: ``batch`` (alias ``preemptible``) is sheddable — the autoscaler flips
+#: the shed set and batch-tier dispatches raise :class:`ShedError`
+_TIERS = ("interactive", "batch")
+_TIER_ALIASES = {"preemptible": "batch"}
+#: tiers currently shedding (overload protection active); flipped by
+#: :func:`shed` — normally only by the ``ht.autoscale`` controller
+_SHED_TIERS: frozenset = frozenset()
+#: total ShedErrors raised since reset (the opsplane counter's source)
+_SHED_STATS = {"refusals": 0}
 _ENV_RATE = _parse_env_rate("HEAT_TPU_ADMISSION_RATE")
 _ENV_BURST = _parse_env_burst(
     "HEAT_TPU_ADMISSION_BURST", _ENV_RATE if _ENV_RATE is not None else 1.0
@@ -408,8 +444,27 @@ def _admit(cid) -> Optional[Any]:
     force blocks until refill — the chain stays pending the whole time,
     mirroring ``admission_hold``. Returns a refund closure fusion invokes
     when the admitted dispatch never runs (a neighbour's batch landed the
-    value during the wait), or ``None`` when no bucket gated."""
+    value during the wait), or ``None`` when no bucket gated.
+
+    Tier shedding composes BEFORE the buckets: a dispatch from a session
+    whose tier is in the shed set raises :class:`ShedError` without
+    consuming anyone's tokens — interactive traffic keeps the whole
+    budget while the overload lasts."""
     sess = _current_session()
+    if (sess is not None and _SHED_TIERS and sess.tier in _SHED_TIERS):
+        sess.stats["shed"] += 1
+        sess._incident("shed", {"tier": sess.tier, "cid": cid})
+        _SHED_STATS["refusals"] += 1
+        if telemetry._MODE >= 2:
+            telemetry.record_event(
+                "admission_shed", tier=sess.tier, session=sess.name, cid=cid
+            )
+        raise ShedError(
+            f"dispatch of chain cid={cid} shed: session {sess.name!r} is "
+            f"{sess.tier}-tier and the overload controller is shedding "
+            f"{sorted(_SHED_TIERS)} — the chain is still pending and "
+            "dispatches cleanly once shedding lifts"
+        )
     buckets: List[_TokenBucket] = []
     if sess is not None and sess.bucket is not None:
         buckets.append(sess.bucket)
@@ -463,28 +518,88 @@ def _admit(cid) -> Optional[Any]:
     return _refund
 
 
+def _root_priority(session_name: Optional[str]):
+    """fusion's ``_ROOT_PRIORITY`` seam: map a root's recording session to
+    a deterministic sort key ``(tier_rank, deadline_ms)`` — interactive
+    roots (rank 0) batch ahead of unattributed roots (rank 1) ahead of
+    batch-tier roots (rank 2), earliest deadline first within a tier. The
+    cross-session batch window orders candidates by this key so a
+    latency-sensitive root is never convoyed behind (or truncated out of a
+    full batch by) a batch tenant's chain. While a tier is being shed, its
+    roots return ``fusion._BATCH_EXCLUDED`` instead — a shed chain must
+    not free-ride a neighbour's batch while the overload lasts (it stays
+    pending and dispatches, or batches, once shedding lifts). Must never
+    raise — fusion calls it inside ``_gather_batch`` under the force
+    lock."""
+    sess = None
+    if session_name is not None:
+        with _LOCK:
+            sess = _SESSIONS.get(session_name)
+    if sess is None:
+        return (1, float("inf"))
+    if _SHED_TIERS and sess.tier in _SHED_TIERS:
+        return fusion._BATCH_EXCLUDED
+    deadline = sess.deadline_ms if sess.deadline_ms is not None else float("inf")
+    return (0 if sess.tier == "interactive" else 2, deadline)
+
+
 def _install_hooks() -> None:
     fusion._SERVING_NOTE = _on_note
     fusion._SESSION_OF = _current_session_name
+    fusion._ROOT_PRIORITY = _root_priority
     _refresh_admit_hook()
 
 
 def _uninstall_hooks() -> None:
     fusion._SERVING_NOTE = None
     fusion._SESSION_OF = None
+    fusion._ROOT_PRIORITY = None
     _refresh_admit_hook()
 
 
 def _refresh_admit_hook() -> None:
-    """The admit hook is live whenever any bucket could gate a dispatch:
-    a global env/set_admission bucket, or an active session with its own."""
-    armed = _GLOBAL_BUCKET is not None
+    """The admit hook is live whenever any bucket could gate a dispatch —
+    a global env/set_admission bucket, or an active session with its own —
+    or a shed set is armed (tier shedding refuses before any bucket)."""
+    armed = _GLOBAL_BUCKET is not None or bool(_SHED_TIERS)
     if not armed:
         with _LOCK:
             armed = any(
                 s.bucket is not None and s._entered > 0 for s in _SESSIONS.values()
             )
     fusion._ADMIT_HOOK = _admit if armed else None
+
+
+def shed(tiers) -> frozenset:
+    """Flip overload shedding for ``tiers`` (an iterable of tier names;
+    empty/``None``/``()`` lifts shedding entirely). While a tier sheds,
+    every fused dispatch from a session of that tier raises
+    :class:`ShedError` BEFORE any token is taken — interactive traffic
+    keeps the whole admission budget. Returns the previous shed set, so
+    callers can restore it. Normally driven by ``ht.autoscale``; safe to
+    call directly (idempotent, takes effect on the next dispatch)."""
+    global _SHED_TIERS
+    prev = _SHED_TIERS
+    resolved = set()
+    for t in tiers or ():
+        t = _TIER_ALIASES.get(t, t)
+        if t not in _TIERS:
+            raise ValueError(
+                f"unknown tier {t!r}: tiers are {_TIERS} "
+                f"(alias {tuple(_TIER_ALIASES)})"
+            )
+        resolved.add(t)
+    _SHED_TIERS = frozenset(resolved)
+    _refresh_admit_hook()
+    return prev
+
+
+def shed_state() -> Dict[str, Any]:
+    """The live shed set + refusal counter (pure module state)."""
+    return {
+        "tiers": sorted(_SHED_TIERS),
+        "refusals": _SHED_STATS["refusals"],
+    }
 
 
 #: cross-session micro batch window (seconds). Armed on ``fusion`` whenever
@@ -531,7 +646,9 @@ class Session:
                  numlens: Optional[str] = None,
                  admission_rate: Optional[float] = None,
                  admission_burst: Optional[float] = None,
-                 policy: Optional[str] = None):
+                 policy: Optional[str] = None,
+                 tier: Optional[str] = None,
+                 deadline_ms: Optional[float] = None):
         self.name = name if name else f"session{next(_SESSION_SEQ)}"
         if errstate is not None and errstate not in ("ignore", "warn", "raise"):
             raise ValueError(
@@ -539,6 +656,16 @@ class Session:
             )
         if policy is not None and policy not in _POLICIES:
             raise ValueError(f"policy must be one of {_POLICIES}, got {policy!r}")
+        tier = _TIER_ALIASES.get(tier, tier)
+        if tier is not None and tier not in _TIERS:
+            raise ValueError(
+                f"tier must be one of {_TIERS} (alias {tuple(_TIER_ALIASES)}), "
+                f"got {tier!r}"
+            )
+        self.tier = tier or "interactive"
+        if deadline_ms is not None and not float(deadline_ms) > 0:
+            raise ValueError(f"deadline_ms must be > 0, got {deadline_ms!r}")
+        self.deadline_ms = None if deadline_ms is None else float(deadline_ms)
         self._errstate = errstate
         self._numlens = numlens
         self.policy = policy
@@ -561,6 +688,7 @@ class Session:
             "admission_refused": 0,
             "admission_waits": 0,
             "admission_waited_s": 0.0,
+            "shed": 0,
         }
         self.incidents: deque = deque(maxlen=64)
         self._entered = 0  # concurrent __enter__ count, across threads
@@ -654,6 +782,8 @@ class Session:
         doc: Dict[str, Any] = {
             "name": self.name,
             "active": self._entered > 0,
+            "tier": self.tier,
+            "deadline_ms": self.deadline_ms,
             "errstate": self._errstate or "inherit",
             "numlens": self._numlens or "inherit",
             "stats": dict(self.stats),
@@ -783,7 +913,13 @@ def set_admission(rate: Optional[float], burst: Optional[float] = None,
                   policy: Optional[str] = None) -> None:
     """Arm (or, with ``rate=None``, disarm) the GLOBAL admission bucket —
     the programmatic form of ``HEAT_TPU_ADMISSION_RATE``/``_BURST``/
-    ``_POLICY``. Per-session buckets are per-:class:`Session` kwargs."""
+    ``_POLICY``. Per-session buckets are per-:class:`Session` kwargs.
+
+    Changing rate/burst on an already-armed bucket reconfigures it IN
+    PLACE: the ``refused``/``waited_s``/``admitted`` counters and the
+    accumulated tokens survive (tokens clamp to the new burst), so a
+    mid-traffic retune — the autoscaler's bread and butter — never zeroes
+    the ops plane's admission counters."""
     global _GLOBAL_BUCKET, _POLICY
     if policy is not None:
         if policy not in _POLICIES:
@@ -794,9 +930,11 @@ def set_admission(rate: Optional[float], burst: Optional[float] = None,
     else:
         if rate <= 0:
             raise ValueError(f"rate must be > 0 tokens/second, got {rate}")
-        _GLOBAL_BUCKET = _TokenBucket(
-            rate, burst if burst is not None else max(rate, 1.0), "global"
-        )
+        resolved_burst = burst if burst is not None else max(rate, 1.0)
+        if _GLOBAL_BUCKET is not None:
+            _GLOBAL_BUCKET.reconfigure(rate, resolved_burst)
+        else:
+            _GLOBAL_BUCKET = _TokenBucket(rate, resolved_burst, "global")
     _refresh_admit_hook()
 
 
@@ -822,6 +960,8 @@ def sessions_block() -> Dict[str, Any]:
         "admission": {
             "policy": _POLICY,
             "global": None if _GLOBAL_BUCKET is None else _GLOBAL_BUCKET.stats(),
+            "shed_tiers": sorted(_SHED_TIERS),
+            "shed_refusals": _SHED_STATS["refusals"],
         },
         "cache": {
             "persistent_dir": _CACHE_DIR,
@@ -845,6 +985,7 @@ def reset() -> None:
             _GLOBAL_BUCKET.admitted = 0
             _GLOBAL_BUCKET.refused = 0
             _GLOBAL_BUCKET.waited_s = 0.0
+    _SHED_STATS["refusals"] = 0
 
 
 # ----------------------------------------------------------------------

@@ -259,6 +259,14 @@ _SYNC_HOOK = None
 #: downtime, steps replayed); None until the elastic module loads.
 _ELASTIC_HOOK = None
 
+#: autoscale-controller stats hook (``core/autoscale.py`` installs its
+#: ``stats`` snapshot here at import — same set-attribute pattern).
+#: ``report()`` joins it as ``report()["autoscale"]`` (controller state,
+#: shed tiers, decision counters, mesh devices vs. baseline) and the
+#: opsplane collector reads it for the ``heat_tpu_autoscale_*`` families;
+#: None until the autoscale module loads.
+_AUTOSCALE_HOOK = None
+
 #: numerics-lens sampling hook (``core/numlens.py`` installs its
 #: ``_on_dispatch`` here via ``numlens.set_mode`` — same set-attribute
 #: pattern). Called by ``fusion.force`` as ``_NUMLENS_HOOK(sig, leaves,
@@ -552,6 +560,12 @@ def reset() -> None:
         from . import opsplane
 
         opsplane.reset()
+    except Exception:  # pragma: no cover - import-order safety only
+        pass
+    try:
+        from . import autoscale
+
+        autoscale.reset()
     except Exception:  # pragma: no cover - import-order safety only
         pass
 
@@ -1568,6 +1582,11 @@ def report(*, _state: Optional[_State] = None) -> Dict[str, Any]:
             doc["elastic"] = _ELASTIC_HOOK()
         except Exception:  # pragma: no cover - the report never fails
             pass
+    if _AUTOSCALE_HOOK is not None:
+        try:
+            doc["autoscale"] = _AUTOSCALE_HOOK()
+        except Exception:  # pragma: no cover - the report never fails
+            pass
     if _MODE >= 2:
         doc["events"] = list(st.events)
     return doc
@@ -2043,6 +2062,13 @@ class _MetricsSink:
                     doc["elastic"] = {} if _ELASTIC_HOOK is None else _ELASTIC_HOOK()
                 except Exception:  # noqa: BLE001 - sink lines never fail
                     doc["elastic"] = {}
+            if "autoscale" not in doc:
+                try:
+                    doc["autoscale"] = (
+                        {} if _AUTOSCALE_HOOK is None else _AUTOSCALE_HOOK()
+                    )
+                except Exception:  # noqa: BLE001 - sink lines never fail
+                    doc["autoscale"] = {}
             line = json.dumps(
                 _jsonable({"ts": time.time(), "event": event, "report": doc}),
                 default=str,

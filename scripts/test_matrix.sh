@@ -234,6 +234,81 @@ with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=10) as r
 assert not problems, problems
 print("ops leg: mid-traffic scrape clean, per-tenant labels present")
 PY
+# autoscale leg (core/autoscale.py, ISSUE 18): the overload controller
+# ARMED while 8 bursty mixed-tier tenants (4 interactive + 4 batch) drive
+# traffic through an injected SLO burn — the loop must shed batch (typed
+# ShedError, chains stay pending), hold every interactive request green,
+# and walk shed -> cooldown -> recover with a bounded decision count
+echo "=== autoscale (controller armed under bursty mixed-tier overload) ==="
+python -m pytest tests/test_autoscale.py -q -x
+python - <<'PY'
+import threading, time
+import numpy as np
+import heat_tpu as ht
+from heat_tpu.core import autoscale, health_runtime, opsplane, serving
+
+warm = ht.array(np.arange(32, dtype=np.float32), split=0)
+float(ht.sum(warm * 2.0))  # mesh + program warm
+health_runtime.set_slo(dispatch_ms=1.0)
+opsplane.set_burn(target=0.9, fast_s=1.0, slow_s=4.0, threshold=1.0,
+                  min_samples=4)
+ctl = autoscale.arm(interval_s=60.0, cooldown_s=0.3, shrink_after_s=3600.0)
+
+# injected latency fault fires the burn alert; the controller sheds batch
+for _ in range(16):
+    health_runtime._slo_observe("dispatch", 0.05)
+opsplane.sample()
+assert autoscale.poll() == "shed_on", autoscale.stats()
+
+interactive_errors, shed_hits = [], []
+barrier = threading.Barrier(8)
+
+def interactive(i):
+    try:
+        barrier.wait(timeout=30)
+        with serving.Session(f"fg{i}", tier="interactive", deadline_ms=100.0):
+            a = ht.array(np.random.default_rng(i).normal(
+                size=(64,)).astype(np.float32), split=0)
+            for r in range(8):
+                float(ht.sum(a * (1.0 + r)))
+    except Exception as exc:
+        interactive_errors.append(exc)
+
+def batch(i):
+    barrier.wait(timeout=30)
+    with serving.Session(f"bg{i}", tier="batch"):
+        a = ht.array(np.random.default_rng(100 + i).normal(
+            size=(64,)).astype(np.float32), split=0)
+        for r in range(8):
+            try:
+                float(ht.sum(a * (1.0 + r)))
+            except serving.ShedError:
+                shed_hits.append(i)
+
+threads = [threading.Thread(target=interactive, args=(i,)) for i in range(4)]
+threads += [threading.Thread(target=batch, args=(i,)) for i in range(4)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(timeout=120)
+assert not interactive_errors, f"interactive failed mid-overload: {interactive_errors}"
+assert shed_hits, "no batch dispatch was shed under overload"
+
+# burn clears + cooldown passes -> recovery; batch dispatches cleanly again
+time.sleep(1.1)
+opsplane.sample()
+autoscale.poll()
+time.sleep(0.35)
+assert autoscale.poll() in ("shed_off", "recover"), autoscale.stats()
+with serving.Session("bg-after", tier="batch"):
+    float(ht.sum(warm * 3.0))
+d = autoscale.stats()["decisions"]
+assert d["shed_on"] == 1 and d["shed_off"] == 1 and d["errors"] == 0, d
+autoscale.disarm()
+health_runtime.set_slo(dispatch_ms=None)
+print(f"autoscale leg: 0 interactive failures, {len(shed_hits)} batch "
+      f"sheds, decisions={d}")
+PY
 # bench regression-sentinel smoke: the file-vs-file compare path (no jax,
 # no measurement) must accept a banked round artifact against itself —
 # exercises record loading, envelope unwrap and threshold plumbing

@@ -1,0 +1,461 @@
+"""SLO-driven autoscaling and overload protection (ISSUE 18): the control
+loop closing serving × elastic × opsplane.
+
+Pins the acceptance criteria: session tiers with typed ShedError
+containment (a shed chain stays pending, is never degraded or
+double-dispatched and never free-rides a neighbour's batch while shedding
+lasts); the controller's observe → decide → act state machine with its
+hysteresis (burn must persist before the mesh shrinks, stay clear through
+a cooldown before recovery) and its ``max_actions``/``min_devices``
+bounds; and the full synthetic-overload loop — injected latency fault →
+burn alert → shed → shrink → cooldown → recover — with ZERO failed
+interactive requests and a bounded, non-flapping decision count. Runs
+green at mesh 1/3/8 (mesh moves are asserted only when the world has
+devices to spare), with fusion off (dispatch-seam tests skip), and under
+``HEAT_TPU_FAULTS=ci``.
+"""
+
+import os
+import threading
+import time
+import unittest
+import warnings
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core import (
+    autoscale,
+    communication,
+    fusion,
+    health_runtime,
+    opsplane,
+    resilience,
+    serving,
+    telemetry,
+)
+
+from harness import TestCase
+
+
+class AutoscaleCase(TestCase):
+    """Clean controller/serving/burn state; exact under the CI fault mix."""
+
+    def setUp(self):
+        self._suspend = resilience.suspended()
+        self._suspend.__enter__()
+        fusion.clear_cache()
+        telemetry.reset()  # cascades: autoscale disarmed, opsplane reset
+        self._prev_slo = health_runtime.set_slo(
+            sync_ms=None, dispatch_ms=None, compile_ms=None
+        )
+        self._prev_burn = opsplane.set_burn()
+        serving.set_admission(None)
+        serving.shed(())
+
+    def tearDown(self):
+        autoscale.disarm(restore=True)  # re-form a shrunken mesh
+        serving.shed(())
+        opsplane.set_burn(**{
+            k: self._prev_burn[k]
+            for k in ("target", "fast_s", "slow_s", "threshold", "min_samples")
+        })
+        health_runtime.set_slo(
+            sync_ms=None if self._prev_slo["sync"] is None else self._prev_slo["sync"] * 1e3,
+            dispatch_ms=None if self._prev_slo["dispatch"] is None else self._prev_slo["dispatch"] * 1e3,
+            compile_ms=None if self._prev_slo["compile"] is None else self._prev_slo["compile"] * 1e3,
+        )
+        serving.set_admission(None)
+        telemetry.reset()
+        self._suspend.__exit__(None, None, None)
+
+    def _client_input(self, seed=0):
+        n = 4 * self.get_size()
+        return ht.array(
+            np.random.default_rng(seed).standard_normal(n).astype(np.float32),
+            split=0,
+        )
+
+    def _arm_burn(self):
+        """The injected-fault alerting config every loop test uses: 1ms
+        dispatch SLO, 1s fast window — 16 synthetic 50ms breaches flip the
+        alert on the next sample."""
+        health_runtime.set_slo(dispatch_ms=1.0)
+        opsplane.set_burn(
+            target=0.9, fast_s=1.0, slow_s=4.0, threshold=1.0, min_samples=4
+        )
+
+    def _ignite(self, n=16):
+        for _ in range(n):
+            health_runtime._slo_observe("dispatch", 0.05)
+        opsplane.sample()
+
+
+# ----------------------------------------------------------------------
+# session tiers + shed semantics
+# ----------------------------------------------------------------------
+class TestTiers(AutoscaleCase):
+    def test_default_tier_is_interactive(self):
+        s = serving.Session("plain")
+        self.assertEqual(s.tier, "interactive")
+        self.assertIsNone(s.deadline_ms)
+
+    def test_preemptible_aliases_to_batch(self):
+        s = serving.Session("spot", tier="preemptible")
+        self.assertEqual(s.tier, "batch")
+
+    def test_unknown_tier_rejected(self):
+        with self.assertRaises(ValueError) as ctx:
+            serving.Session("typo", tier="bulk")
+        self.assertIn("bulk", str(ctx.exception))
+
+    def test_deadline_must_be_positive(self):
+        with self.assertRaises(ValueError):
+            serving.Session("late", deadline_ms=0)
+        with self.assertRaises(ValueError):
+            serving.Session("later", deadline_ms=-5)
+
+    def test_report_carries_tier_and_deadline(self):
+        with serving.Session("doc", tier="batch", deadline_ms=250) as s:
+            doc = s.report()
+        self.assertEqual(doc["tier"], "batch")
+        self.assertEqual(doc["deadline_ms"], 250.0)
+
+    def test_shed_rejects_unknown_tier(self):
+        with self.assertRaises(ValueError):
+            serving.shed(("bulk",))
+        self.assertEqual(serving.shed_state()["tiers"], [])
+
+    def test_shed_state_and_sessions_block_surface_the_flip(self):
+        prev = serving.shed(("preemptible",))  # alias resolves
+        try:
+            self.assertEqual(prev, frozenset())
+            self.assertEqual(serving.shed_state()["tiers"], ["batch"])
+            block = serving.sessions_block()
+            self.assertEqual(block["admission"]["shed_tiers"], ["batch"])
+        finally:
+            serving.shed(())
+        self.assertEqual(serving.shed_state()["tiers"], [])
+
+    def test_readyz_reflects_active_shedding(self):
+        self.assertTrue(opsplane.ready_status()["checks"]["shedding"])
+        serving.shed(("batch",))
+        try:
+            doc = opsplane.ready_status()
+            self.assertFalse(doc["checks"]["shedding"])
+            self.assertEqual(doc["status"], "unready")
+        finally:
+            serving.shed(())
+        self.assertTrue(opsplane.ready_status()["checks"]["shedding"])
+
+
+class TestShedContainment(AutoscaleCase):
+    @pytest.mark.skipif(not fusion.active(), reason="fusion disabled")
+    def test_shed_error_is_typed_and_counted(self):
+        """ShedError subclasses AdmissionError (one except clause catches
+        both refusal kinds) and the refusal lands on the session's stats,
+        the module counter and the opsplane gauge."""
+        serving.shed(("batch",))
+        try:
+            with serving.Session("bg", tier="batch") as sess:
+                a = self._client_input(1)
+                pending = ht.sum(a * 2.0)
+                with self.assertRaises(serving.AdmissionError) as ctx:
+                    float(pending)
+                self.assertIsInstance(ctx.exception, serving.ShedError)
+                self.assertTrue(fusion.is_deferred(pending))
+                self.assertEqual(sess.stats["shed"], 1)
+                self.assertEqual(serving.shed_state()["refusals"], 1)
+        finally:
+            serving.shed(())
+        opsplane.sample()
+        self.assertIn(
+            "heat_tpu_autoscale_shed_refusals_total 1", opsplane.render()
+        )
+
+    @pytest.mark.skipif(not fusion.active(), reason="fusion disabled")
+    def test_interactive_never_gated_while_batch_sheds(self):
+        serving.shed(("batch",))
+        try:
+            with serving.Session("fg", tier="interactive", deadline_ms=50):
+                a = self._client_input(2)
+                self.assertAlmostEqual(
+                    float(ht.sum(a * 3.0)),
+                    float(np.sum(a.numpy() * 3.0)),
+                    places=3,
+                )
+        finally:
+            serving.shed(())
+
+
+# ----------------------------------------------------------------------
+# the controller state machine (driven tick-by-tick via poll())
+# ----------------------------------------------------------------------
+class TestControllerDecisions(AutoscaleCase):
+    def _arm_inert(self, **over):
+        """Arm with a daemon cadence long enough that every decision in
+        the test comes from an explicit poll() — deterministic ticks. The
+        shrink hysteresis defaults far out so shed-only tests never move
+        the mesh; shrink tests override it to 0."""
+        cfg = dict(interval_s=60.0, cooldown_s=0.3, shrink_after_s=3600.0,
+                   max_actions=4, min_devices=1, shrink_n=1)
+        cfg.update(over)
+        return autoscale.arm(**cfg)
+
+    def test_burn_edge_sheds_then_sustained_clear_recovers(self):
+        """The hysteresis pin: one rising edge flips shedding ON (one
+        decision, no flap while the level holds); shedding lifts only
+        after the burn stays clear through the cooldown."""
+        self._arm_burn()
+        ctl = self._arm_inert(cooldown_s=0.3)
+        self._ignite()
+        self.assertEqual(autoscale.poll(), "shed_on")
+        self.assertEqual(ctl.state, "shedding")
+        self.assertEqual(serving.shed_state()["tiers"], ["batch"])
+        self.assertGreaterEqual(ctl.burn_edges, 1)  # on_burn woke the loop
+        # level holds: more ticks, no new shed decisions (non-flapping)
+        self._ignite(4)
+        autoscale.poll()
+        self.assertEqual(ctl.decisions["shed_on"], 1)
+        # burn drains, but the cooldown has not elapsed: still shedding
+        time.sleep(1.1)
+        opsplane.sample()
+        autoscale.poll()
+        self.assertEqual(ctl.state, "shedding")
+        self.assertEqual(ctl.decisions["shed_off"], 0)
+        # a clear SUSTAINED through the cooldown finally lifts it
+        time.sleep(0.35)
+        self.assertIn(autoscale.poll(), ("shed_off", "recover"))
+        self.assertEqual(ctl.state, "ok")
+        self.assertEqual(serving.shed_state()["tiers"], [])
+        self.assertEqual(ctl.decisions["shed_on"], 1)
+        self.assertEqual(ctl.decisions["shed_off"], 1)
+
+    def test_burn_reriring_during_cooldown_restarts_it(self):
+        self._arm_burn()
+        ctl = self._arm_inert(cooldown_s=0.5)
+        self._ignite()
+        self.assertEqual(autoscale.poll(), "shed_on")
+        time.sleep(1.1)  # drain: the clear clock starts
+        opsplane.sample()
+        autoscale.poll()
+        self._ignite()  # burn re-rises mid-cooldown
+        autoscale.poll()
+        time.sleep(1.1)  # drain again: the clock must restart from here
+        opsplane.sample()
+        autoscale.poll()
+        self.assertEqual(
+            ctl.state, "shedding",
+            "the cooldown survived a burn re-rise — hysteresis broken",
+        )
+        self.assertEqual(ctl.decisions["shed_on"], 1)  # still one flip
+
+    def test_min_devices_floor_blocks_the_shrink(self):
+        """With the floor at the current world size the mesh never moves:
+        the controller sheds, holds, and recovers without one reform."""
+        a = self._client_input(3)
+        float(ht.sum(a * 2.0))  # mesh up
+        world = len(communication.MESH_WORLD.devices)
+        self._arm_burn()
+        ctl = self._arm_inert(min_devices=world, shrink_after_s=0.0)
+        self._ignite()
+        self.assertEqual(autoscale.poll(), "shed_on")
+        self.assertIsNone(autoscale.poll())  # shrink refused by the floor
+        self.assertEqual(ctl.decisions["shrink"], 0)
+        self.assertEqual(ctl.mesh_actions, 0)
+        self.assertEqual(len(communication.MESH_WORLD.devices), world)
+
+    def test_max_actions_budget_bounds_mesh_moves(self):
+        a = self._client_input(4)
+        float(ht.sum(a * 2.0))
+        world = len(communication.MESH_WORLD.devices)
+        if world < 2:
+            raise unittest.SkipTest("needs a multi-device mesh to shrink")
+        self._arm_burn()
+        ctl = self._arm_inert(max_actions=0, shrink_after_s=0.0)
+        self._ignite()
+        self.assertEqual(autoscale.poll(), "shed_on")
+        self.assertIsNone(autoscale.poll())  # budget spent before arming
+        self.assertEqual(ctl.decisions["shrink"], 0)
+        self.assertEqual(ctl.decisions["bound"], 1)  # loud, and only once
+        self.assertIsNone(autoscale.poll())
+        self.assertEqual(ctl.decisions["bound"], 1)
+        self.assertEqual(len(communication.MESH_WORLD.devices), world)
+
+    def test_disarm_lifts_shedding_and_unsubscribes(self):
+        self._arm_burn()
+        self._arm_inert()
+        self._ignite()
+        self.assertEqual(autoscale.poll(), "shed_on")
+        autoscale.disarm()
+        self.assertFalse(autoscale.armed())
+        self.assertEqual(serving.shed_state()["tiers"], [])
+        self.assertIsNone(autoscale.poll())  # nothing armed: no-op
+
+    def test_stats_feed_report_and_metrics(self):
+        ctl = self._arm_inert()
+        st = autoscale.stats()
+        self.assertTrue(st["armed"])
+        self.assertEqual(st["state"], "ok")
+        self.assertIs(telemetry._AUTOSCALE_HOOK, autoscale.stats)
+        self.assertEqual(telemetry.report()["autoscale"]["state"], "ok")
+        opsplane.sample()
+        text = opsplane.render()
+        self.assertIn("heat_tpu_autoscale_armed 1", text)
+        self.assertIn("heat_tpu_autoscale_shedding 0", text)
+        self.assertEqual(ctl.snapshot()["decisions"]["errors"], 0)
+
+    def test_env_knobs_warn_and_keep_defaults(self):
+        prev = os.environ.get("HEAT_TPU_AUTOSCALE_COOLDOWN_S")
+        os.environ["HEAT_TPU_AUTOSCALE_COOLDOWN_S"] = "not-a-number"
+        try:
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                cfg = autoscale._defaults()
+            self.assertEqual(cfg["cooldown_s"], 30.0)
+            self.assertTrue(
+                any("HEAT_TPU_AUTOSCALE_COOLDOWN_S" in str(w.message)
+                    for w in caught)
+            )
+        finally:
+            if prev is None:
+                del os.environ["HEAT_TPU_AUTOSCALE_COOLDOWN_S"]
+            else:
+                os.environ["HEAT_TPU_AUTOSCALE_COOLDOWN_S"] = prev
+
+    def test_invalid_controller_config_rejected(self):
+        with self.assertRaises(ValueError):
+            autoscale.Controller(interval_s=0)
+        with self.assertRaises(ValueError):
+            autoscale.Controller(min_devices=0)
+        with self.assertRaises(ValueError):
+            autoscale.Controller(shed_tiers=("bulk",))
+
+
+# ----------------------------------------------------------------------
+# the pinned acceptance loop
+# ----------------------------------------------------------------------
+class TestOverloadAcceptance(AutoscaleCase):
+    @pytest.mark.skipif(not fusion.active(), reason="fusion disabled")
+    def test_injected_overload_sheds_shrinks_cools_down_recovers(self):
+        """The ISSUE 18 acceptance pin: a synthetic latency fault fires the
+        burn alert; the armed controller sheds batch, shrinks the mesh
+        (when there are devices to spare), rides the cooldown and recovers
+        to the full world — with ZERO failed interactive requests across
+        8 bursty mixed-tier tenants and a bounded, non-flapping decision
+        count."""
+        warm = self._client_input(5)
+        float(ht.sum(warm * 2.0))  # mesh + program warm
+        world = len(communication.MESH_WORLD.devices)
+        self._arm_burn()
+        ctl = autoscale.arm(
+            interval_s=60.0, cooldown_s=0.3, shrink_after_s=0.0,
+            max_actions=4, min_devices=1, shrink_n=1,
+        )
+        prev_mode = telemetry.set_mode(2)
+        interactive_errors = []
+        shed_hits = []
+        try:
+            # -- overload: the fault injection fires the alert ----------
+            self._ignite()
+            self.assertEqual(autoscale.poll(), "shed_on")
+            if world > 1:
+                self.assertEqual(autoscale.poll(), "shrink")
+                self.assertEqual(
+                    len(communication.MESH_WORLD.devices), world - 1
+                )
+                self.assertEqual(ctl.snapshot()["mesh"]["baseline"], world)
+
+            # -- bursty mixed-tier traffic mid-overload -----------------
+            barrier = threading.Barrier(8)
+
+            def interactive(k):
+                try:
+                    barrier.wait(timeout=10)
+                    with serving.Session(f"fg-{k}", tier="interactive",
+                                         deadline_ms=100.0):
+                        a = self._client_input(10 + k)
+                        for j in range(3):
+                            float(ht.sum(a * float(j + 2)))
+                except Exception as exc:  # noqa: BLE001 - the pin is zero
+                    interactive_errors.append(exc)
+
+            def batch(k):
+                barrier.wait(timeout=10)
+                with serving.Session(f"bg-{k}", tier="batch"):
+                    a = self._client_input(20 + k)
+                    for j in range(3):
+                        try:
+                            float(ht.sum(a * float(j + 2)))
+                        except serving.ShedError:
+                            shed_hits.append(k)
+
+            threads = [
+                threading.Thread(target=interactive, args=(k,))
+                for k in range(4)
+            ] + [threading.Thread(target=batch, args=(k,)) for k in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            self.assertEqual(
+                interactive_errors, [],
+                "an interactive request failed during the overload",
+            )
+            self.assertGreaterEqual(
+                len(shed_hits), 1, "no batch dispatch was shed mid-overload"
+            )
+            self.assertGreaterEqual(serving.shed_state()["refusals"], 1)
+
+            # -- recovery: burn clears, cooldown passes -----------------
+            time.sleep(1.1)  # drain the fast window
+            opsplane.sample()
+            autoscale.poll()  # observes the clear; cooldown starts
+            self.assertEqual(ctl.state, "shrunk" if world > 1 else "shedding")
+            time.sleep(0.35)
+            action = autoscale.poll()
+            self.assertEqual(action, "recover" if world > 1 else "shed_off")
+            self.assertEqual(ctl.state, "ok")
+            self.assertEqual(len(communication.MESH_WORLD.devices), world)
+            self.assertEqual(serving.shed_state()["tiers"], [])
+
+            # a batch tenant dispatches cleanly after recovery
+            with serving.Session("bg-after", tier="batch"):
+                b = self._client_input(30)
+                self.assertAlmostEqual(
+                    float(ht.sum(b * 7.0)),
+                    float(np.sum(b.numpy() * 7.0)),
+                    places=3,
+                )
+
+            # -- bounded, non-flapping decision count (the pin) ---------
+            d = ctl.snapshot()["decisions"]
+            self.assertEqual(d["shed_on"], 1)
+            self.assertEqual(d["shed_off"], 1)
+            self.assertEqual(d["shrink"], 1 if world > 1 else 0)
+            self.assertEqual(d["recover"], 1 if world > 1 else 0)
+            self.assertEqual(d["errors"], 0)
+            self.assertLessEqual(ctl.mesh_actions, 4)
+
+            # every decision is on the record: events + gauges
+            kinds = [
+                e["kind"] for e in telemetry._GLOBAL.events
+                if str(e["kind"]).startswith("autoscale_")
+            ]
+            self.assertIn("autoscale_shed_on", kinds)
+            self.assertIn(
+                "autoscale_shed_off" if world == 1 else "autoscale_recover",
+                kinds,
+            )
+            opsplane.sample()
+            text = opsplane.render()
+            self.assertIn(
+                'heat_tpu_autoscale_decisions_total{action="shed_on"} 1', text
+            )
+        finally:
+            telemetry.set_mode(prev_mode)
+
+
+if __name__ == "__main__":
+    unittest.main()

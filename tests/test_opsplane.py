@@ -285,6 +285,85 @@ class TestBurn(OpsCase):
         self.assertEqual(opsplane.health_status()["status"], "ok")
 
 
+class TestOnBurn(OpsCase):
+    """The ISSUE 18 subscription seam: on_burn callbacks fire on rising
+    AND falling alert edges, after the burn lock releases, with each
+    dispatch logged to the recorder and a raising subscriber contained."""
+
+    def _ignite(self):
+        health_runtime.set_slo(dispatch_ms=1.0)
+        opsplane.set_burn(
+            target=0.9, fast_s=1.0, slow_s=4.0, threshold=1.0, min_samples=4
+        )
+        for _ in range(16):
+            health_runtime._slo_observe("dispatch", 0.05)
+
+    def test_rising_and_falling_edges_dispatch_and_are_logged(self):
+        calls = []
+
+        def watcher(metric, tenant, rising, snapshot):
+            # reading burn_report() here would deadlock if callbacks ran
+            # under _BURN_LOCK — the dispatch-after-release contract
+            opsplane.burn_report()
+            calls.append((metric, tenant, rising, snapshot["active"]))
+
+        unsub = opsplane.on_burn(watcher)
+        try:
+            with telemetry.enabled(2):
+                self._ignite()
+                opsplane.sample()
+                self.assertEqual(calls, [("dispatch", "*", True, True)])
+                time.sleep(1.1)  # drain the fast window: falling edge
+                opsplane.sample()
+                self.assertEqual(calls[-1], ("dispatch", "*", False, False))
+                logged = [
+                    e for e in telemetry._GLOBAL.events
+                    if e["kind"] == "burn_callback"
+                ]
+            self.assertEqual(len(logged), 2)
+            self.assertEqual(logged[0]["callback"], "watcher")
+            self.assertTrue(logged[0]["rising"])
+            self.assertFalse(logged[1]["rising"])
+        finally:
+            unsub()
+        # unsubscribed: a fresh burn cycle dispatches nothing
+        n = len(calls)
+        self._ignite()
+        opsplane.sample()
+        self.assertEqual(len(calls), n)
+
+    def test_raising_subscriber_contained_and_counted(self):
+        seen = []
+
+        def broken(metric, tenant, rising, snapshot):
+            raise RuntimeError("subscriber bug")
+
+        def healthy(metric, tenant, rising, snapshot):
+            seen.append(rising)
+
+        unsub_a = opsplane.on_burn(broken)
+        unsub_b = opsplane.on_burn(healthy)
+        try:
+            self._ignite()
+            opsplane.sample()  # must not raise
+            self.assertEqual(seen, [True])  # the healthy one still ran
+            self.assertGreaterEqual(
+                opsplane.status()["stats"]["callback_errors"], 1
+            )
+        finally:
+            unsub_a()
+            unsub_b()
+
+    def test_on_burn_rejects_non_callable(self):
+        with self.assertRaises(TypeError):
+            opsplane.on_burn("not a callback")
+
+    def test_unsubscribe_is_idempotent(self):
+        unsub = opsplane.on_burn(lambda *a: None)
+        unsub()
+        unsub()  # second call is a no-op, never a ValueError
+
+
 # ----------------------------------------------------------------------
 # the ops HTTP server
 # ----------------------------------------------------------------------
@@ -517,7 +596,7 @@ class TestMetricsSinkSchema(OpsCase):
         "unfused_reasons", "retraces", "degraded", "nonfinite", "io_retries",
         "checkpoint", "faults", "jit_compiles", "spans", "timeline", "scopes",
         "memory", "health", "numerics", "fusion_cache", "programs", "timers",
-        "serving", "elastic",
+        "serving", "elastic", "autoscale",
     }
 
     def test_sink_line_carries_every_block_with_no_sessions(self):
@@ -545,6 +624,7 @@ class TestMetricsSinkSchema(OpsCase):
         self.assertIn("slo", line["report"]["health"])
         self.assertIn("mode", line["report"]["numerics"])
         self.assertIn("reforms", line["report"]["elastic"])
+        self.assertIn("state", line["report"]["autoscale"])
 
     def test_sink_line_schema_identical_with_traffic(self):
         with tempfile.TemporaryDirectory() as d:

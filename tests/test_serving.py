@@ -463,6 +463,39 @@ class TestAdmission(ServingCase):
             float(ht.sum(a * 3.0))
         self.assertIn("global", str(ctx.exception))
 
+    @pytest.mark.skipif(not fusion.active(), reason="fusion disabled")
+    def test_set_admission_hot_update_preserves_counters(self):
+        """The ISSUE 18 satellite pin: re-tuning a live bucket's rate/burst
+        mid-traffic reconfigures it IN PLACE — the refused/waited_s billing
+        counters survive and accumulated tokens clamp to the new burst,
+        instead of the old rebuild-and-forget."""
+        serving.set_admission(0.5, 1, policy="raise")
+        bucket = serving._GLOBAL_BUCKET
+        a = self._client_input(16)
+        float(ht.sum(a * 2.0))  # spends the only token
+        with self.assertRaises(serving.AdmissionError):
+            float(ht.sum(a * 3.0))
+        self.assertEqual(bucket.refused, 1)
+        serving.set_admission(100, 8, policy="raise")
+        # same object, counters intact, config live
+        self.assertIs(serving._GLOBAL_BUCKET, bucket)
+        self.assertEqual(bucket.refused, 1)
+        self.assertGreaterEqual(bucket.admitted, 1)
+        self.assertEqual(bucket.rate, 100.0)
+        self.assertEqual(bucket.burst, 8.0)
+        # the empty bucket stayed empty through the upgrade (no fresh-bucket
+        # grace burst) — it refuses until the NEW rate actually refills it
+        with self.assertRaises(serving.AdmissionError):
+            float(ht.sum(a * 4.0))
+        time.sleep(0.05)  # 100/s refill: ~5 tokens
+        float(ht.sum(a * 4.0))
+        # clamping down: accumulated tokens never exceed the new burst
+        time.sleep(0.05)  # refill toward burst=8 at 100/s
+        serving.set_admission(100, 2, policy="raise")
+        self.assertIs(serving._GLOBAL_BUCKET, bucket)
+        with bucket._lock:
+            self.assertLessEqual(bucket.tokens, 2.0)
+
 
 class TestGateComposition(ServingCase):
     """Admission token bucket x memledger headroom x elastic hold: a chain
@@ -544,6 +577,74 @@ class TestGateComposition(ServingCase):
                     telemetry.report()["async_forcing"]["dispatches"], before
                 )
         finally:
+            telemetry.set_mode(prev_mode)
+
+    @pytest.mark.skipif(not fusion.active(), reason="fusion disabled")
+    def test_shed_tier_chain_dispatches_cleanly_after_recovery(self):
+        """ISSUE 18 tier-flip composition: a batch-tier chain refused
+        mid-overload (ShedError) stays pending and never degraded; once
+        the controller lifts shedding the SAME chain force-dispatches
+        exactly once, while interactive traffic was never gated at all."""
+        prev_mode = telemetry.set_mode(1)
+        try:
+            serving.shed(("batch",))
+            with serving.Session("bg", tier="preemptible") as bg:  # alias
+                a = self._client_input(17)
+                pending = ht.sum(a * 4.0)
+                with self.assertRaises(serving.ShedError) as ctx:
+                    float(pending)
+                self.assertIn("bg", str(ctx.exception))
+                self.assertTrue(fusion.is_deferred(pending))
+                self.assertEqual(fusion.cache_stats()["degraded"], 0)
+                self.assertEqual(bg.stats["shed"], 1)
+                # interactive neighbour keeps dispatching mid-overload
+                with serving.Session("fg", tier="interactive"):
+                    b = self._client_input(18)
+                    float(ht.sum(b * 5.0))
+                before = telemetry.report()["async_forcing"]["dispatches"]
+                serving.shed(())  # recovery: shedding lifts
+                self.assertAlmostEqual(
+                    float(pending), float(np.sum(a.numpy() * 4.0)), places=3
+                )
+                self.assertEqual(
+                    telemetry.report()["async_forcing"]["dispatches"],
+                    before + 1,
+                )
+        finally:
+            serving.shed(())
+            telemetry.set_mode(prev_mode)
+
+    @pytest.mark.skipif(not fusion.active(), reason="fusion disabled")
+    def test_shed_tier_chain_absorbed_by_neighbor_batch(self):
+        """Shed-refusal composes with the drain-exclusion contract exactly
+        like an admission refusal: after shedding lifts, a neighbour's
+        force may absorb the still-pending batch-tier root into its batch
+        — reading it then adds NO dispatch (never double-dispatched)."""
+        prev_mode = telemetry.set_mode(1)
+        try:
+            serving.shed(("batch",))
+            with serving.Session("bursty-batch", tier="batch"):
+                a = self._client_input(19)
+                pending = ht.sum(a * 9.0)
+                with self.assertRaises(serving.ShedError):
+                    float(pending)
+                self.assertTrue(fusion.is_deferred(pending))
+                serving.shed(())  # overload over
+                other = self._client_input(15)
+                float(ht.sum(other * 9.0))  # same program family: batches
+                self.assertGreaterEqual(
+                    telemetry.report()["async_forcing"]["multi_root_batches"],
+                    1,
+                )
+                before = telemetry.report()["async_forcing"]["dispatches"]
+                self.assertAlmostEqual(
+                    float(pending), float(np.sum(a.numpy() * 9.0)), places=3
+                )
+                self.assertEqual(
+                    telemetry.report()["async_forcing"]["dispatches"], before
+                )
+        finally:
+            serving.shed(())
             telemetry.set_mode(prev_mode)
 
 
@@ -664,8 +765,15 @@ class TestServingThroughput(ServingCase):
         # GIL (default switch interval 5ms), so one batched dispatch plus one
         # scheduler quantum is the irreducible tail; on real accelerators
         # dispatch itself dwarfs the floor and the 2x ratio is what binds.
+        # The floor scales with thread overcommit: when 8 client threads
+        # share fewer cores, a root legitimately waits multiple scheduler
+        # quanta before its batch window even closes, so the one-quantum
+        # floor would flag the OS scheduler, not a convoy (observed p99
+        # ~14ms on a loaded 1-core host with healthy batching). On >= 8
+        # cores the factor is 1 and the pin is unchanged.
+        floor = 5e-3 * max(1.0, 8 / (os.cpu_count() or 1))
         self.assertLessEqual(
-            p99_8, 2.0 * max(p99_1, 5e-3),
+            p99_8, 2.0 * max(p99_1, floor),
             f"p99 N=8 {p99_8 * 1e3:.3f}ms vs N=1 {p99_1 * 1e3:.3f}ms",
         )
 

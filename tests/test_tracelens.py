@@ -241,7 +241,11 @@ class TestStragglerAttribution(TracelensCase):
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                 text=True, env=env, cwd=_REPO,
             ))
-            if len(procs) >= 4:  # bound concurrent jax imports
+            # bound concurrent jax imports by core count: on a 1-core host
+            # co-scheduled workers' import/record quanta show up as ~100ms+
+            # cumulative lag on FAST hosts — rivaling the injected delay the
+            # assertion must attribute — so workers run serially there
+            if len(procs) >= max(1, min(4, os.cpu_count() or 1)):
                 procs.pop(0).wait()
         for p in procs:
             p.wait()

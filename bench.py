@@ -1197,6 +1197,84 @@ def worker() -> None:
     except Exception:  # noqa: BLE001 - diagnostics must never cost the record
         pass
 
+    # multi-process runtime leg (core/multihost.py, ISSUE 19): REAL spawned
+    # worker processes joined into one process-spanning mesh over loopback
+    # gloo, driven by scripts/multiproc_trainer.py. Two gauges:
+    # multiproc_weak_scaling — aggregate row throughput of the 2-process
+    # world over the 1-process world with rows-per-process held constant
+    # (on one box the workers SHARE physical cores, so per-process step
+    # rate halving is core contention, not runtime cost; aggregate rows/s
+    # isolates what the runtime itself adds: dual controllers, the gloo
+    # psum, lease beats — target >= 0.9x). peer_loss_recovery_ms — SIGKILL
+    # one worker mid-step and time from the kill to the reformed
+    # generation's first progress beacon (detection + drain + respawn +
+    # re-init + checkpoint restore: the whole recovery bill). Runs AFTER
+    # the record is banked (hang-safety invariant).
+    try:
+        import glob as _mp_glob
+        import shutil as _mp_shutil
+        import subprocess as _mp_subprocess
+        import tempfile as _mp_tempfile
+
+        from heat_tpu.core import multihost as _multihost
+
+        _mp_trainer = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "scripts", "multiproc_trainer.py",
+        )
+
+        def _mp_run(n, root, rows, steps, **kw):
+            cmd = [
+                sys.executable, _mp_trainer,
+                "--steps", str(steps), "--checkpoint-every", "2",
+                "--rows", str(rows), "--dim", "256",
+                "--ckpt-dir", os.path.join(root, "ckpt"),
+                "--out", os.path.join(root, "out"),
+            ]
+            return _multihost.spawn_local(
+                n, cmd, timeout_s=180.0, stdout=_mp_subprocess.DEVNULL, **kw
+            )
+
+        def _mp_rate(root):
+            best = 0.0
+            for p in _mp_glob.glob(os.path.join(root, "out", "result-*.json")):
+                with open(p) as fh:
+                    d = json.load(fh)
+                if d.get("status") == "done" and d.get("rate_steps_per_s"):
+                    best = max(best, float(d["rate_steps_per_s"]))
+            return best
+
+        _mp_root = _mp_tempfile.mkdtemp(prefix="heat_tpu_bench_mp_")
+        _mp_new = False
+        try:
+            _MP_ROWS = 32768  # rows PER PROCESS (weak scaling)
+            _mp_r1 = _mp_run(1, os.path.join(_mp_root, "w1"), _MP_ROWS, 12)
+            _mp_r2 = _mp_run(2, os.path.join(_mp_root, "w2"), 2 * _MP_ROWS, 12)
+            _mp_rate1 = _mp_rate(os.path.join(_mp_root, "w1"))
+            _mp_rate2 = _mp_rate(os.path.join(_mp_root, "w2"))
+            if _mp_r1["ok"] and _mp_r2["ok"] and _mp_rate1 > 0 and _mp_rate2 > 0:
+                record["multiproc_weak_scaling"] = round(
+                    (_mp_rate2 * 2.0 * _MP_ROWS) / (_mp_rate1 * _MP_ROWS), 2
+                )
+                _mp_new = True
+            _mp_rk = _mp_run(
+                2, os.path.join(_mp_root, "wkill"), 64, 8,
+                max_reforms=1, kill={"rank": 1, "at_step": 3},
+            )
+            if _mp_rk["ok"] and _mp_rk["reforms"] == 1 and _mp_rk["t_kill"]:
+                _mp_g1 = _mp_rk["generations"][1]
+                if _mp_g1.get("t_first_progress"):
+                    record["peer_loss_recovery_ms"] = round(
+                        (_mp_g1["t_first_progress"] - _mp_rk["t_kill"]) * 1e3, 1
+                    )
+                    _mp_new = True
+            if _mp_new:
+                print(json.dumps(record), flush=True)  # last parseable line wins
+        finally:
+            _mp_shutil.rmtree(_mp_root, ignore_errors=True)
+    except Exception:  # noqa: BLE001 - diagnostics must never cost the record
+        pass
+
     # static-analysis leg (heat_tpu/analysis, ISSUE 7): the AST lint's wall
     # time over the library (the pre-commit budget a CI hook would pay) and
     # the AOT program auditor's finding count over the program cache the
@@ -1840,6 +1918,20 @@ _AUTOSCALE_CEILINGS = {
     "batch_shed_pct": 100.0,
 }
 
+#: multi-process runtime gauges (core/multihost.py). Weak scaling is a
+#: RATIO with an ABSOLUTE floor — aggregate row throughput of the
+#: 2-process world over the 1-process world at fixed rows-per-process must
+#: stay >= 0.9x (higher is better: the rate slack and overhead noise logic
+#: both invert, and a hard target beats a banked-relative one here).
+_MULTIPROC_FLOORS = {
+    "multiproc_weak_scaling": 0.9,
+}
+#: ...and the recovery bill of one SIGKILL -> detect -> drain -> respawn ->
+#: restore cycle in ms, with the elastic-style cost-ceiling noise logic
+_MULTIPROC_CEILINGS = {
+    "peer_loss_recovery_ms": 30000.0,
+}
+
 #: serving counters that must be EXACTLY zero — steady-state traffic never
 #: recompiles and a warm process against a populated cache dir never
 #: compiles; no noise slack applies (a retrace is a bug, not jitter)
@@ -1995,6 +2087,29 @@ def compare_records(fresh: dict, banked: dict, slack: float = 0.30) -> dict:
         limit = ceiling if b is None else max(ceiling, b * 1.5 + 2.0)
         if key == "batch_shed_pct":
             limit = min(limit, 100.0)  # a percentage cannot regress past 100
+        if f > limit:
+            regressions.append(
+                f"{key}: fresh {f:g} > limit {limit:g} "
+                f"(ceiling {ceiling:g}, banked {b if b is not None else 'n/a'})"
+            )
+    for key, floor in _MULTIPROC_FLOORS.items():
+        f, b = _num(fresh, key), _num(banked, key)
+        if f is None:
+            if b is not None:
+                notes.append(f"{key}: banked={b:g} but missing from fresh record")
+            continue
+        if f < floor:
+            regressions.append(
+                f"{key}: fresh {f:g} < floor {floor:g} (absolute weak-scaling "
+                f"target; banked {b if b is not None else 'n/a'})"
+            )
+    for key, ceiling in _MULTIPROC_CEILINGS.items():
+        f, b = _num(fresh, key), _num(banked, key)
+        if f is None:
+            if b is not None:
+                notes.append(f"{key}: banked={b:g} but missing from fresh record")
+            continue
+        limit = ceiling if b is None else max(ceiling, b * 1.5 + 2.0)
         if f > limit:
             regressions.append(
                 f"{key}: fresh {f:g} > limit {limit:g} "

@@ -8,13 +8,19 @@ goes flaky. The reference framework inherits a fixed MPI world and dies on
 rank loss; here the supervisor closes the detect→drain→checkpoint→re-form→
 resume loop on a *running* job:
 
-1. **Detect** — four triggers feed one poll (:meth:`Supervisor.maybe_preempt`):
+1. **Detect** — five triggers feed one poll (:meth:`Supervisor.maybe_preempt`):
    a SIGTERM/signal hook (``HEAT_TPU_ELASTIC_SIGNALS``, default ``SIGTERM``),
    the ``elastic.preempt`` fault site (so ``HEAT_TPU_FAULTS`` kills a host
    deterministically), :func:`probe_devices` health probes on collective
-   failure, and escalation from resilience's per-device fault ledger —
+   failure, escalation from resilience's per-device fault ledger —
    N repeated ``collective.*``/dispatch faults attributable to one device
-   degrade the *mesh*, not the job (``resilience.note_device_fault``).
+   degrade the *mesh*, not the job (``resilience.note_device_fault``) —
+   and ``multihost``'s lease daemon declaring a peer *process* lost. A lost
+   peer takes the cross-process path: drain → best-effort commit →
+   :class:`multihost.PeerLostError`, handing the worker back to the local
+   launcher (``multihost.spawn_local``) for a respawn into a smaller world
+   under a new mesh epoch (in-process reform across processes is impossible:
+   XLA's coordination service hard-kills the survivors of a dead peer).
 2. **Drain + commit** — stop admitting new fused dispatches
    (``memledger.admission_hold``, the same gate seam the memory budget
    uses), drain live fusion roots under a watchdog-guarded deadline
@@ -47,7 +53,10 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
-from . import communication, fusion, health_runtime, memledger, resilience, telemetry
+from . import (
+    communication, fusion, health_runtime, memledger, multihost, resilience,
+    telemetry,
+)
 
 __all__ = [
     "ElasticError",
@@ -95,6 +104,7 @@ _STATS: Dict[str, Any] = {
     "downtime_ms": 0.0,     # cumulative drain→restore wall time
     "drained_roots": 0,     # live fusion roots forced during drains
     "checkpoints": 0,       # commits through the supervisor
+    "peer_losses": 0,       # cross-process losses handed to the launcher
     "last_reform": None,    # {"step","mesh","downtime_ms","reason"} of the newest
 }
 
@@ -113,7 +123,8 @@ def reset() -> None:
     cascade, so a bench scope never reports the previous run's reforms)."""
     _STATS.update(
         preemptions=0, reforms=0, failed_reforms=0, steps_replayed=0,
-        downtime_ms=0.0, drained_roots=0, checkpoints=0, last_reform=None,
+        downtime_ms=0.0, drained_roots=0, checkpoints=0, peer_losses=0,
+        last_reform=None,
     )
 
 
@@ -287,6 +298,7 @@ class Supervisor:
         self.comm = communication.sanitize_comm(comm)
         self.reforms = 0
         self._seen_degraded: set = set()
+        self._seen_lost: set = set()
         self._prev_handlers: List[Tuple[int, Any]] = []
         if install_signals:
             self._install_signals()
@@ -325,6 +337,12 @@ class Supervisor:
                 resilience.check("elastic.preempt")
             except Exception as exc:  # noqa: BLE001 - the fault IS the notice
                 return Preempted(f"injected: {exc}")
+        lost = multihost.lost_peers() - self._seen_lost
+        if lost:
+            self._seen_lost |= lost
+            pre = Preempted(f"peer process(es) {sorted(lost)} lost mid-run")
+            pre.peers = tuple(sorted(lost))
+            return pre
         degraded = resilience.degraded_devices() - self._seen_degraded
         if degraded:
             self._seen_degraded |= degraded
@@ -419,6 +437,40 @@ class Supervisor:
                 "elastic_preempt", reason=pre.reason, step=step, mesh=self.comm.size
             )
         health_runtime.auto_dump("elastic_preempt")
+        peers = tuple(getattr(pre, "peers", ()))
+        if peers:
+            # a lost PEER (not a lost device) cannot be reformed from inside
+            # this process: XLA's coordination service hard-kills the
+            # survivors of a dead peer, and the shrunk process world needs a
+            # fresh coordinator epoch. Drain, attempt a best-effort local
+            # commit (expected to fail fast once the cooperative barrier is
+            # unreachable), then hand the process back to the launcher: the
+            # raised PeerLostError is the worker's cue to exit REFORM_EXIT
+            # and be respawned into the smaller world.
+            _STATS["peer_losses"] += 1
+            with memledger.admission_hold(f"peer lost at step {step}: {pre.reason}"):
+                self.drain()
+                if get_state is not None:
+                    try:
+                        self.commit(get_state(), step)
+                    except Exception as exc:  # noqa: BLE001 - best-effort only
+                        warnings.warn(
+                            f"post-loss checkpoint at step {step} failed "
+                            f"({exc!r}); the reformed world restores from the "
+                            "newest verified step",
+                            stacklevel=2,
+                        )
+            if telemetry._MODE:
+                telemetry.record_event(
+                    "elastic_peer_lost", peers=list(peers), step=step,
+                    mesh=self.comm.size,
+                )
+            health_runtime.auto_dump("elastic_peer_lost")
+            raise multihost.PeerLostError(
+                f"peer process(es) {sorted(peers)} lost at step {step}: "
+                "drained; exit for launcher-mediated reform "
+                f"(exit code {multihost.REFORM_EXIT})", peers=peers,
+            )
         try:
             with memledger.admission_hold(f"preempted at step {step}: {pre.reason}"):
                 self.drain()

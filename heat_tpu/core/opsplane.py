@@ -192,6 +192,14 @@ SCHEMA: "OrderedDict[str, Dict[str, Any]]" = OrderedDict(
         # -- numerics lens ---------------------------------------------
         ("heat_tpu_numerics_dispatches_sampled_total", (_C, "Dispatches the numerics lens sampled.", [])),
         ("heat_tpu_numerics_findings", (_G, "Open numerics findings.", [])),
+        # -- multi-process runtime (lease heartbeats + named barriers) -
+        ("heat_tpu_peers_expected", (_G, "Controller processes in the current world.", [])),
+        ("heat_tpu_peers_lost", (_G, "Peer processes currently declared lost.", [])),
+        ("heat_tpu_peer_heartbeats_total", (_C, "Lease heartbeats written.", [])),
+        ("heat_tpu_peer_heartbeat_errors_total", (_C, "Lease beats that failed to write (missed beats).", [])),
+        ("heat_tpu_barriers_total", (_C, "Named cross-process barrier waits entered.", [])),
+        ("heat_tpu_barrier_timeouts_total", (_C, "Barriers abandoned on timeout (StallError).", [])),
+        ("heat_tpu_barrier_threads_abandoned", (_G, "Abandoned barrier daemon threads still alive.", [])),
         # -- elastic supervisor ----------------------------------------
         ("heat_tpu_elastic_total", (_C, "Elastic supervisor events, by event.", ["event"])),
         ("heat_tpu_elastic_downtime_ms_total", (_C, "Cumulative drain-to-restore wall time.", [])),
@@ -348,10 +356,33 @@ def _collect_elastic(out: List[Sample]) -> None:
     stats = hook()
     for event in (
         "preemptions", "reforms", "failed_reforms", "steps_replayed",
-        "checkpoints", "drained_roots",
+        "checkpoints", "drained_roots", "peer_losses",
     ):
-        out.append(("heat_tpu_elastic_total", {"event": event}, float(stats[event])))
+        if event in stats:
+            out.append(("heat_tpu_elastic_total", {"event": event}, float(stats[event])))
     out.append(("heat_tpu_elastic_downtime_ms_total", {}, float(stats["downtime_ms"])))
+
+
+def _collect_multihost(out: List[Sample]) -> None:
+    # set-attribute hook (the _ELASTIC_HOOK pattern): core/multihost.py
+    # installs report_stats on telemetry at import
+    hook = telemetry._MULTIHOST_HOOK
+    if hook is None:
+        return
+    st = hook()
+    out.append(("heat_tpu_peers_expected", {}, float(st.get("world", 1))))
+    out.append(("heat_tpu_peers_lost", {}, float(len(st.get("peers_lost") or ()))))
+    out.append(("heat_tpu_peer_heartbeats_total", {}, float(st.get("heartbeats", 0))))
+    out.append(
+        ("heat_tpu_peer_heartbeat_errors_total", {}, float(st.get("heartbeat_errors", 0)))
+    )
+    out.append(("heat_tpu_barriers_total", {}, float(st.get("barriers", 0))))
+    out.append(
+        ("heat_tpu_barrier_timeouts_total", {}, float(st.get("barrier_timeouts", 0)))
+    )
+    out.append(
+        ("heat_tpu_barrier_threads_abandoned", {}, float(st.get("abandoned_alive", 0)))
+    )
 
 
 def _bucket_tokens(bucket) -> float:
@@ -475,6 +506,7 @@ _COLLECTORS = (
     _collect_elastic,
     _collect_serving,
     _collect_autoscale,
+    _collect_multihost,
 )
 
 
@@ -1035,7 +1067,8 @@ def health_status() -> Dict[str, Any]:
 def ready_status() -> Dict[str, Any]:
     """Readiness: healthy AND the mesh is up AND global admission is not
     saturated (the global bucket, when armed, projects at least one
-    token). ``{"status": "ok"|"unready", "checks": {...}}``."""
+    token) AND no peer process is declared lost.
+    ``{"status": "ok"|"unready", "checks": {...}}``."""
     doc = health_status()
     checks = dict(doc["checks"])
     checks["mesh"] = _mesh_up()
@@ -1058,6 +1091,16 @@ def ready_status() -> Dict[str, Any]:
     except Exception:  # pragma: no cover - import-order safety only
         pass
     checks["shedding"] = shedding_ok
+    peers_ok = True
+    try:
+        hook = telemetry._MULTIHOST_HOOK
+        if hook is not None:
+            # a lost peer means cross-process collectives/barriers cannot
+            # complete: unready until the launcher reforms the world
+            peers_ok = not (hook().get("peers_lost") or ())
+    except Exception:  # pragma: no cover - import-order safety only
+        pass
+    checks["peers"] = peers_ok
     return {
         "status": "ok" if all(checks.values()) else "unready",
         "checks": checks,

@@ -43,6 +43,16 @@ site                  where it fires
 ``checkpoint.gc``     each retention / debris deletion of checkpoint GC
                       (failures degrade to a warning; debris waits for the
                       next sweep)
+``multihost.init``    each ``multihost.initialize_distributed`` connect
+                      attempt — an injected ``ConnectionResetError``
+                      exercises exactly the retry/backoff path a flaky
+                      coordinator would
+``multihost.barrier`` every ``multihost.sync_processes`` entry (before the
+                      single-host early-out, so the blocked-barrier paths
+                      are testable without two processes)
+``multihost.heartbeat``  each lease-beat write of the peer-liveness daemon
+                      — a fired fault reads as a *missed* beat (counted in
+                      ``report()["multihost"]``), never a daemon crash
 ``elastic.preempt``   the elastic supervisor's per-step preemption poll
                       (``core/elastic.py``) — arming it kills-a-host
                       deterministically: the supervisor converts the fault

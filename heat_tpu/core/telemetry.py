@@ -267,6 +267,14 @@ _ELASTIC_HOOK = None
 #: None until the autoscale module loads.
 _AUTOSCALE_HOOK = None
 
+#: multi-process runtime stats hook (``core/multihost.py`` installs its
+#: ``report_stats`` snapshot here at import — same set-attribute pattern).
+#: ``report()`` joins it as ``report()["multihost"]`` (heartbeats, lost
+#: peers, barrier waits/timeouts, abandoned barrier threads) and the
+#: opsplane collector reads it for the ``heat_tpu_peers_*`` /
+#: ``heat_tpu_barrier_*`` families; None until the multihost module loads.
+_MULTIHOST_HOOK = None
+
 #: numerics-lens sampling hook (``core/numlens.py`` installs its
 #: ``_on_dispatch`` here via ``numlens.set_mode`` — same set-attribute
 #: pattern). Called by ``fusion.force`` as ``_NUMLENS_HOOK(sig, leaves,
@@ -1585,6 +1593,11 @@ def report(*, _state: Optional[_State] = None) -> Dict[str, Any]:
     if _AUTOSCALE_HOOK is not None:
         try:
             doc["autoscale"] = _AUTOSCALE_HOOK()
+        except Exception:  # pragma: no cover - the report never fails
+            pass
+    if _MULTIHOST_HOOK is not None:
+        try:
+            doc["multihost"] = _MULTIHOST_HOOK()
         except Exception:  # pragma: no cover - the report never fails
             pass
     if _MODE >= 2:

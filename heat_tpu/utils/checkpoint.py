@@ -487,6 +487,18 @@ def save_checkpoint(directory: str, tree: Any, step: int = 0, keep: int = 3) -> 
     from ..core.dndarray import DNDarray
 
     step = int(step)
+    lost = multihost.lost_peers()
+    if lost:
+        # a cooperative save cannot commit with a dead peer: its shard files
+        # and receipt will never land, and the save/commit barriers would
+        # only time out. Fail fast and NAMED — the elastic supervisor's
+        # best-effort post-loss commit expects exactly this — and restore
+        # from the newest step that verified while the world was whole.
+        raise multihost.PeerLostError(
+            f"checkpoint save at step {step} aborted: peer process(es) "
+            f"{sorted(lost)} lost; a cross-process commit cannot complete",
+            peers=lost,
+        )
     os.makedirs(directory, exist_ok=True)
     payload_rel = _payload_rel_for_save(directory, step)
     payload_dir = os.path.join(directory, payload_rel)

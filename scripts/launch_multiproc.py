@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Local multi-process launcher/supervisor for the fault-tolerant runtime.
+
+Spawns ``-n`` worker processes on this machine (each a full JAX controller
+joined into one process-spanning mesh over loopback gloo), supervises
+their lease files, and — when a worker dies or hangs — reforms: survivors
+drain and exit ``REFORM_EXIT``, the launcher re-ranks them into a
+contiguous smaller world under a bumped ``HEAT_TPU_MESH_EPOCH`` and a
+fresh coordinator, and the respawned generation restores from the newest
+verifying checkpoint. This is a thin CLI over
+:func:`heat_tpu.core.multihost.spawn_local`; see
+``doc/internals_distribution.md`` for the reform contract.
+
+Everything after ``--`` is the worker command (run once per process with
+the launcher's env applied). Without one, the default acceptance workload
+``scripts/multiproc_trainer.py`` runs; trainer flags can follow ``--``
+normally, e.g.::
+
+    python scripts/launch_multiproc.py -n 2 -- \\
+        python scripts/multiproc_trainer.py --steps 8 \\
+            --ckpt-dir /tmp/ckpt --out /tmp/out
+
+Deterministic chaos (for CI / bench): ``--kill-rank R --kill-at-step S``
+SIGKILLs rank R from outside once its progress beacon passes step S;
+``--kill-after-s T`` kills after a wall-clock delay instead. The launcher
+prints the run summary as JSON and exits 0 only if the final generation
+completed cleanly.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    worker_cmd = None
+    if "--" in argv:
+        split = argv.index("--")
+        argv, worker_cmd = argv[:split], argv[split + 1 :]
+
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    ap.add_argument("-n", "--num-processes", type=int, default=2)
+    ap.add_argument("--mesh-dir", default=None,
+                    help="shared coordination dir (default: a fresh tempdir)")
+    ap.add_argument("--max-reforms", type=int, default=1)
+    ap.add_argument("--devices-per-process", type=int, default=1)
+    ap.add_argument("--barrier-timeout-ms", type=float, default=30_000.0)
+    ap.add_argument("--heartbeat-ms", type=float, default=200.0)
+    ap.add_argument("--peer-lost-ms", type=float, default=None)
+    ap.add_argument("--timeout-s", type=float, default=600.0)
+    ap.add_argument("--kill-rank", type=int, default=None)
+    ap.add_argument("--kill-at-step", type=int, default=None)
+    ap.add_argument("--kill-after-s", type=float, default=None)
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress worker stdout/stderr")
+    args = ap.parse_args(argv)
+
+    from heat_tpu.core import multihost
+
+    if not worker_cmd:
+        trainer = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "multiproc_trainer.py")
+        scratch = args.mesh_dir or os.path.join("/tmp", f"heat_tpu_mp_{os.getpid()}")
+        worker_cmd = [
+            sys.executable, trainer,
+            "--ckpt-dir", os.path.join(scratch, "ckpt"),
+            "--out", os.path.join(scratch, "out"),
+        ]
+
+    kill = None
+    if args.kill_rank is not None:
+        kill = {"rank": args.kill_rank}
+        if args.kill_at_step is not None:
+            kill["at_step"] = args.kill_at_step
+        elif args.kill_after_s is not None:
+            kill["after_s"] = args.kill_after_s
+        else:
+            ap.error("--kill-rank needs --kill-at-step or --kill-after-s")
+
+    result = multihost.spawn_local(
+        args.num_processes,
+        worker_cmd,
+        mesh=args.mesh_dir,
+        max_reforms=args.max_reforms,
+        devices_per_process=args.devices_per_process,
+        barrier_timeout_ms=args.barrier_timeout_ms,
+        heartbeat_ms=args.heartbeat_ms,
+        peer_lost_ms=args.peer_lost_ms,
+        timeout_s=args.timeout_s,
+        kill=kill,
+        stdout=__import__("subprocess").DEVNULL if args.quiet else None,
+    )
+    json.dump(result, sys.stdout, indent=2, sort_keys=True, default=str)
+    print()
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,7 +16,7 @@ for size in ${@:-1 3 5 8}; do
   echo "=== mesh size $size ==="
   HEAT_TPU_TEST_DEVICES=$size \
   HEAT_TPU_COVERAGE="$COV_DIR/cov_mesh$size.json" \
-    python -m pytest tests/ -q -x
+    python -m pytest tests/ -q -x -m "not slow"
   legs+=("$COV_DIR/cov_mesh$size.json")
 done
 # fusion leg: the eager engines (HEAT_TPU_FUSION=0 escape hatch) must match
@@ -136,6 +136,36 @@ HEAT_TPU_TELEMETRY=1 \
 echo "=== elasticity (HEAT_TPU_FAULTS='elastic.preempt:every=7') ==="
 HEAT_TPU_FAULTS='elastic.preempt:every=7' HEAT_TPU_TELEMETRY=1 \
   python -m pytest tests/test_elastic.py tests/test_checkpoint_resilience.py -q -x
+# multi-process runtime leg (core/multihost.py, ISSUE 19): REAL coordinated
+# worker processes — a 2-process mesh over loopback gloo, supervised across
+# reform generations. The slow-marked suite (excluded from the mesh loop
+# above) runs under the ambient CI fault mix, which the launcher propagates
+# into every worker's environment: cross-process collectives, world-size
+# invariance, SIGKILL-mid-step reform with checkpoint-equality, the
+# hung-peer drain watchdog. Then the launcher CLI drives one SIGKILL chaos
+# run end to end: kill rank 1 mid-step, the survivor drains with
+# REFORM_EXIT, and the reformed 1-process world restores from the newest
+# verifying checkpoint and completes.
+echo "=== multi-process runtime (2-proc gloo mesh, -m slow, HEAT_TPU_FAULTS=ci) ==="
+HEAT_TPU_FAULTS=ci python -m pytest tests/test_multiproc.py -q -x -m slow
+MP_SCRATCH=$(mktemp -d)
+python scripts/launch_multiproc.py -n 2 --max-reforms 1 \
+  --kill-rank 1 --kill-at-step 3 --quiet -- \
+  python scripts/multiproc_trainer.py --steps 8 --checkpoint-every 2 \
+    --ckpt-dir "$MP_SCRATCH/ckpt" --out "$MP_SCRATCH/out" \
+  > "$MP_SCRATCH/result.json"
+python - "$MP_SCRATCH" <<'PY'
+import glob, json, os, sys
+scratch = sys.argv[1]
+r = json.load(open(os.path.join(scratch, "result.json")))
+assert r["ok"] and r["reforms"] == 1, r
+docs = [json.load(open(p))
+        for p in glob.glob(os.path.join(scratch, "out", "result-*.json"))]
+final = [d for d in docs if d["status"] == "done"]
+assert final and final[0]["resumed_from"] is not None, docs
+print("multiproc leg: reform OK, resumed from step", final[0]["resumed_from"])
+PY
+rm -rf "$MP_SCRATCH"
 # serving leg (core/serving.py, ISSUE 15): the multi-tenant session layer —
 # the suite drives N=8 threaded clients through session isolation, admission
 # gates and cross-session batching (zero steady-state retraces, flat p99);

@@ -596,7 +596,7 @@ class TestMetricsSinkSchema(OpsCase):
         "unfused_reasons", "retraces", "degraded", "nonfinite", "io_retries",
         "checkpoint", "faults", "jit_compiles", "spans", "timeline", "scopes",
         "memory", "health", "numerics", "fusion_cache", "programs", "timers",
-        "serving", "elastic", "autoscale",
+        "serving", "elastic", "autoscale", "multihost",
     }
 
     def test_sink_line_carries_every_block_with_no_sessions(self):

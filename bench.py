@@ -485,6 +485,86 @@ def worker() -> None:
     # costs only the diagnostic fields, never the tracked configs
     print(json.dumps(record), flush=True)
 
+    # whole-algorithm estimator leg (ISSUE 20): the collective-DAG-node
+    # contract witnesses, banked AFTER the record (hang-safety invariant).
+    # (a) estimator_syncs_per_iter — blocking syncs of ONE warm
+    # reduce->matmul estimator iteration (mean -> centered matmul -> sum,
+    # the Lloyd/CG shape): with matmul and the split-axis reductions
+    # recording as DAG nodes the whole iteration compiles into one program
+    # and costs <= 1 blocking sync. The assertion is load-bearing — a
+    # regression to force-at-collective would bank 3+ syncs/iter and the
+    # gauge is withheld rather than banked mislabelled (same contract as
+    # reduction_chain_syncs_per_chain).
+    try:
+        est_n = (32768 // comm.size) * comm.size
+        est_x = ht.array(
+            jax.device_put(
+                jax.random.normal(jax.random.PRNGKey(11), (est_n, 16), dtype=jnp.float32),
+                comm.sharding(2, 0),
+            ),
+            is_split=0,
+        )
+        est_w = ht.array(
+            jax.random.normal(jax.random.PRNGKey(12), (16, 8), dtype=jnp.float32),
+            split=None,
+        )
+
+        def _estimator_iter_once():
+            mu = ht.mean(est_x)
+            return float(ht.sum((est_x - mu) @ est_w))
+
+        _estimator_iter_once()  # warm: compile + program cache
+        _estimator_iter_once()
+        with _telemetry.enabled():
+            _telemetry.reset()
+            _estimator_iter_once()
+            _sync0 = _telemetry.async_forcing()["blocking_total"]
+            _estimator_iter_once()
+            _per_iter = _telemetry.async_forcing()["blocking_total"] - _sync0
+        if _fusion.collectives_active() and _per_iter > 1:
+            raise AssertionError(
+                f"whole-algorithm estimator iteration took {_per_iter} "
+                "blocking syncs, expected <= 1"
+            )
+        record["estimator_syncs_per_iter"] = _per_iter
+        print(json.dumps(record), flush=True)  # last parseable line wins
+    except Exception:  # noqa: BLE001 - diagnostics must never cost the record
+        pass
+
+    # (b) lasso_sweeps_per_sec — warm coordinate-descent sweep rate of a
+    # Lasso fit over sharded samples (regression/lasso.py): the CD sweep is
+    # the lasso half of the whole-algorithm acceptance budget (ISSUE 20),
+    # so its rate banks next to kmeans_iters_per_sec and gates via the
+    # _RATE_KEYS -30% floor like the other throughput metrics.
+    try:
+        lasso_n = (16384 // comm.size) * comm.size
+        lasso_x = ht.array(
+            jax.device_put(
+                jax.random.normal(jax.random.PRNGKey(13), (lasso_n, 12), dtype=jnp.float32),
+                comm.sharding(2, 0),
+            ),
+            is_split=0,
+        )
+        lasso_y = ht.array(
+            jax.device_put(
+                jax.random.normal(jax.random.PRNGKey(14), (lasso_n,), dtype=jnp.float32),
+                comm.sharding(1, 0),
+            ),
+            is_split=0,
+        )
+        _sweeps = 20
+        _lasso_est = ht.regression.Lasso(lam=0.1, max_iter=_sweeps, tol=None)
+        _lasso_est.fit(lasso_x, lasso_y)  # warm: compile the sweep programs
+        lasso_best = float("inf")
+        for _ in range(3):
+            start = time.perf_counter()
+            _lasso_est.fit(lasso_x, lasso_y)
+            lasso_best = min(lasso_best, time.perf_counter() - start)
+        record["lasso_sweeps_per_sec"] = round(_sweeps / lasso_best, 1)
+        print(json.dumps(record), flush=True)  # last parseable line wins
+    except Exception:  # noqa: BLE001 - diagnostics must never cost the record
+        pass
+
     # telemetry legs (core/telemetry.py) run AFTER the record is banked —
     # they re-execute measured ops, so a hang here may cost only these
     # diagnostic fields: the chain rate with the observability layer on
@@ -1840,6 +1920,7 @@ _RATE_KEYS = (
     "lloyd_hbm_gbps",
     "moments_hbm_gbps",
     "lloyd_iters_per_sec_marginal",
+    "lasso_sweeps_per_sec",
 )
 
 #: overhead percentages with absolute ceilings (the subsystem contracts);
@@ -1930,6 +2011,15 @@ _MULTIPROC_FLOORS = {
 #: restore cycle in ms, with the elastic-style cost-ceiling noise logic
 _MULTIPROC_CEILINGS = {
     "peer_loss_recovery_ms": 30000.0,
+}
+
+#: whole-algorithm estimator gauge (ISSUE 20): blocking syncs of one warm
+#: reduce->matmul estimator iteration. The collective-DAG contract is <= 1
+#: (the worker withholds the gauge rather than bank a broken value when
+#: collectives are active); same ``max(ceiling, banked*1.5+2.0)`` noise
+#: logic as the overhead gauges for collectives-off records
+_ESTIMATOR_CEILINGS = {
+    "estimator_syncs_per_iter": 1.0,
 }
 
 #: serving counters that must be EXACTLY zero — steady-state traffic never
@@ -2104,6 +2194,18 @@ def compare_records(fresh: dict, banked: dict, slack: float = 0.30) -> dict:
                 f"target; banked {b if b is not None else 'n/a'})"
             )
     for key, ceiling in _MULTIPROC_CEILINGS.items():
+        f, b = _num(fresh, key), _num(banked, key)
+        if f is None:
+            if b is not None:
+                notes.append(f"{key}: banked={b:g} but missing from fresh record")
+            continue
+        limit = ceiling if b is None else max(ceiling, b * 1.5 + 2.0)
+        if f > limit:
+            regressions.append(
+                f"{key}: fresh {f:g} > limit {limit:g} "
+                f"(ceiling {ceiling:g}, banked {b if b is not None else 'n/a'})"
+            )
+    for key, ceiling in _ESTIMATOR_CEILINGS.items():
         f, b = _num(fresh, key), _num(banked, key)
         if f is None:
             if b is not None:

@@ -124,8 +124,13 @@ __all__ = [
     "cost_error_count",
     "disabled",
     "defer_apply",
+    "defer_matmul",
+    "defer_multi",
+    "defer_op",
     "defer_reshard",
     "force",
+    "phys_node",
+    "record_multi",
     "is_deferred",
     "cache_stats",
     "clear_cache",
@@ -310,6 +315,35 @@ def _reshard_op(x, *, sharding):
     return jax.device_put(x, sharding)
 
 
+def _matmul_op(a, b, *, a_sharding, b_sharding, out_sharding):
+    # the matmul case table as a DAG node: under a trace the operand/result
+    # sharding constraints pin the same schedule _matmul_program's in/out
+    # shardings pin (split-0 @ *: local contraction; * @ split-1: local;
+    # both-split-1/split-0-rhs: GSPMD's psum/allgather), compiled INTO the
+    # enclosing chain's program; the eager replay (guarded forcing's
+    # degraded arm) is device_put + matmul — the same placement the pinned
+    # program produces
+    if isinstance(a, jax.core.Tracer):
+        a = jax.lax.with_sharding_constraint(a, a_sharding)
+    elif isinstance(a, jax.Array):
+        a = jax.device_put(a, a_sharding)
+    if isinstance(b, jax.core.Tracer):
+        b = jax.lax.with_sharding_constraint(b, b_sharding)
+    elif isinstance(b, jax.Array):
+        b = jax.device_put(b, b_sharding)
+    out = jnp.matmul(a, b)
+    if isinstance(out, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(out, out_sharding)
+    return jax.device_put(out, out_sharding)
+
+
+def _pick_op(t, *, i):
+    # selector over a multi-output kernel node's result tuple: each output of
+    # a record_multi parent is one _pick_op node, so the DAG stays
+    # single-value per node while the kernel itself runs once per program
+    return t[i]
+
+
 def _aval(c) -> Tuple[Tuple[int, ...], np.dtype]:
     if isinstance(c, LazyArray):
         return c.shape, c.dtype
@@ -378,6 +412,62 @@ def record(fn, children, **kw) -> LazyArray:
     if _SESSION_OF is not None:
         node.session = _SESSION_OF()
     return node
+
+
+@functools.lru_cache(maxsize=8192)
+def _infer_multi_cached(fn, child_avals, kw):
+    """Abstract per-output (shape, dtype) of a tuple-returning ``fn`` via one
+    cached ``jax.eval_shape`` — the multi-output analog of ``_infer_cached``."""
+    args = [jax.ShapeDtypeStruct(s, d) for s, d in child_avals]
+    kw_d = dict(kw)
+    outs = jax.eval_shape(lambda *a: fn(*a, **kw_d), *args)
+    return tuple((tuple(o.shape), np.dtype(o.dtype)) for o in outs)
+
+
+def record_multi(fn, children, **kw) -> Tuple[LazyArray, ...]:
+    """Record a tuple-returning kernel as ONE parent node plus one
+    ``_pick_op`` selector node per output, and return the selector tuple.
+
+    The parent stays interior to the DAG — forcing any selector runs the
+    kernel once inside the fused program, and ``_gather_batch``'s sibling
+    rule pulls the other selectors into the same dispatch so every output
+    lands together (TSQR's Q/R, CholQR2's Q/R/ok, the halo pair)."""
+    if resilience._ARMED:
+        resilience.check("fusion.record")
+    kw_t = tuple(sorted(kw.items()))
+    depth = 1 + max(
+        (c.depth for c in children if isinstance(c, LazyArray) and c._value is None),
+        default=0,
+    )
+    if depth > _MAX_CHAIN:
+        children = tuple(
+            force(c) if isinstance(c, LazyArray) and c._value is None else c
+            for c in children
+        )
+        depth = 1
+    cid = 0
+    for c in children:
+        if isinstance(c, LazyArray) and c._value is None:
+            cid = c.cid  # join the pending chain's lifecycle
+            break
+    if not cid:
+        cid = next(_CID_SEQ)
+    avals = _infer_multi_cached(fn, tuple(_aval(c) for c in children), kw_t)
+    if telemetry._MODE >= 2:
+        telemetry.record_event(
+            "record", op=getattr(fn, "__name__", str(fn)), cid=cid, depth=depth
+        )
+    # the parent's own aval is never consumed (only _pick_op children refer
+    # to it, with their own hand-assigned avals) — stamp the first output's
+    session = _SESSION_OF() if _SESSION_OF is not None else None
+    parent = LazyArray(fn, tuple(children), kw_t, avals[0][0], avals[0][1], depth, cid)
+    parent.session = session
+    picks = []
+    for i, (shape, dtype) in enumerate(avals):
+        pick = LazyArray(_pick_op, (parent,), (("i", i),), shape, dtype, depth + 1, cid)
+        pick.session = session
+        picks.append(pick)
+    return tuple(picks)
 
 
 def cast(c, jax_dtype) -> LazyArray:
@@ -705,7 +795,17 @@ def _gather_batch(entries, leaves, memo, roots):
         if _DRAIN_EXCLUDE and id(payload) in _DRAIN_EXCLUDE:
             continue  # part of the chain held at the admission gate
         if _node_nbytes(payload) > _BATCH_BYTES:
-            continue
+            # sibling outputs of one multi-output kernel ride along
+            # regardless of size: their shared parent is already interior to
+            # this batch's walk, so the kernel runs once either way and the
+            # extra output write is free — leaving the sibling behind would
+            # re-run the whole kernel at its own later force
+            if not (
+                payload.fn is _pick_op
+                and payload.children
+                and id(payload.children[0]) in memo
+            ):
+                continue
         if getattr(wrapper.comm, "device_set", None) != device_set:
             continue  # different comm/mesh: never fuse across device sets
         _walk(payload, entries, leaves, memo)
@@ -1433,11 +1533,16 @@ def _apply_fn(mesh, axis_name, kernel, in_splits, ndims, out_split, check_vma):
         entries[split] = axis_name
         return PartitionSpec(*entries)
 
-    out_spec = (
-        PartitionSpec()
-        if out_split is None
-        else PartitionSpec(*([None] * out_split), axis_name)
-    )
+    def ospec(split):
+        if split is None:
+            return PartitionSpec()
+        return PartitionSpec(*([None] * split), axis_name)
+
+    if isinstance(out_split, tuple):
+        # multi-output kernel: one spec per output (record_multi's picks)
+        out_spec = tuple(ospec(s) for s in out_split)
+    else:
+        out_spec = ospec(out_split)
     fn = jax.shard_map(
         kernel,
         mesh=mesh,
@@ -1454,13 +1559,17 @@ def _apply_fn(mesh, axis_name, kernel, in_splits, ndims, out_split, check_vma):
 
 
 def defer_apply(comm, kernel, xs, in_splits, out_split, check_vma: bool = False):
-    """Record a single-output ``shard_map`` kernel over ``comm``'s mesh as a
-    DAG node, so record→kernel→record chains compile into ONE program (the
-    deferred form of ``MeshCommunication.apply``). ``xs`` entries are
-    DNDarrays (pending chains stay pending) or concrete arrays; returns the
-    LazyArray node — callers wrap their own global metadata via
-    :func:`wrap_node` — or None to decline (multi-output kernels, padded or
-    tracer operands, record failures → the eager ``comm.apply`` path).
+    """Record a ``shard_map`` kernel over ``comm``'s mesh as DAG node(s), so
+    record→kernel→record chains compile into ONE program (the deferred form
+    of ``MeshCommunication.apply``). ``xs`` entries are DNDarrays (pending
+    chains stay pending), already-recorded LazyArray nodes, or concrete
+    arrays. With a scalar ``out_split`` the kernel is single-output and ONE
+    LazyArray node is returned; a tuple/list ``out_split`` declares a
+    multi-output kernel (one split entry per output) and a TUPLE of selector
+    nodes comes back — one per output, all landing in the same dispatch via
+    :func:`record_multi`'s sibling batching. Callers wrap their own global
+    metadata via :func:`wrap_node`; None means declined (padded or tracer
+    operands, record failures → the eager ``comm.apply`` path).
 
     The ``collective.apply`` fault site fires here at record time, every
     call; the in-kernel ``collective.<verb>`` sites and their telemetry
@@ -1472,8 +1581,9 @@ def defer_apply(comm, kernel, xs, in_splits, out_split, check_vma: bool = False)
         _resolve_siblings()
     if not (_ENABLED and _COLLECTIVES):
         return None
-    if isinstance(out_split, (tuple, list)):
-        return _unfused("apply", "multi_output")
+    multi = isinstance(out_split, (tuple, list))
+    if multi:
+        out_split = tuple(out_split)
     if getattr(kernel, "_no_fusion", False):
         return _unfused("apply", "no_fusion_op")
     children = []
@@ -1485,6 +1595,9 @@ def defer_apply(comm, kernel, xs, in_splits, out_split, check_vma: bool = False)
             child = _phys_node(x)
             if child is None:
                 return _unfused("apply", "tracer_payload")
+        elif isinstance(x, LazyArray):
+            # a pre-recorded operand node (a cast/reshape the caller staged)
+            child = x if x._value is None else x._value
         elif isinstance(x, (jax.Array, np.ndarray)):
             child = x
         else:
@@ -1505,16 +1618,172 @@ def defer_apply(comm, kernel, xs, in_splits, out_split, check_vma: bool = False)
             out_split,
             check_vma,
         )
-        node = record(fn, tuple(children))
+        if multi:
+            nodes = record_multi(fn, tuple(children))
+        else:
+            nodes = record(fn, tuple(children))
     except Exception as exc:  # narrowed: ONE policy decides what falls back
         if not resilience.record_recoverable(exc):
             raise
         return _unfused("apply", "record_failed:" + type(exc).__name__)
     if telemetry._MODE:
+        cid = nodes[0].cid if multi else nodes.cid
         telemetry.record_fused_collective(
-            "apply:" + getattr(kernel, "__name__", "kernel"), cid=node.cid
+            "apply:" + getattr(kernel, "__name__", "kernel"), cid=cid
+        )
+    return nodes
+
+
+def phys_node(x):
+    """Public :func:`_phys_node`: a DNDarray's physical payload as a
+    recordable child (pending node or concrete array), or None for tracer
+    payloads — deferral call sites stage casts/reshapes on it with
+    :func:`record`/:func:`cast` before handing it to :func:`defer_apply`."""
+    if DNDarray is None:
+        _resolve_siblings()
+    return _phys_node(x)
+
+
+def _operand_children(engine, xs):
+    """Shared operand intake for the global-view deferral front-ends: the
+    LOGICAL node of each DNDarray (padding sliced off inside the program —
+    global-view kernels see exactly ``larray``), pre-recorded nodes and
+    concrete arrays as-is. Returns None (after the ``_unfused`` breadcrumb)
+    when any operand cannot be recorded."""
+    children = []
+    for x in xs:
+        if isinstance(x, DNDarray):
+            child = _logical_node(x)
+            if child is None:
+                return _unfused(engine, "tracer_payload")
+        elif isinstance(x, LazyArray):
+            child = x if x._value is None else x._value
+        elif isinstance(x, (jax.Array, np.ndarray)):
+            child = x
+        else:
+            return _unfused(engine, "foreign_operand")
+        children.append(child)
+    return children
+
+
+def defer_op(fn, xs, **kw):
+    """Record a single-output global-view op over DNDarray/array operands
+    (their LOGICAL views — padding is sliced off inside the program, GSPMD
+    schedules any collectives the op implies). Returns the LazyArray node,
+    or None to decline — the eager path's exact global-view semantics make
+    this the deferral seam for jit-level kernels like CG's fused step."""
+    if DNDarray is None:
+        _resolve_siblings()
+    if not (_ENABLED and _COLLECTIVES):
+        return None
+    if getattr(fn, "_no_fusion", False):
+        return _unfused("op", "no_fusion_op")
+    if not hashable_kwargs(kw):
+        return _unfused("op", "unhashable_kwargs")
+    try:
+        # _logical_node records the un-pad slice: inside the guard, like
+        # defer_reduce — a record-time failure there falls back to eager
+        children = _operand_children("op", xs)
+        if children is None:
+            return None
+        node = record(fn, tuple(children), **kw)
+    except Exception as exc:  # narrowed: ONE policy decides what falls back
+        if not resilience.record_recoverable(exc):
+            raise
+        return _unfused("op", "record_failed:" + type(exc).__name__)
+    if telemetry._MODE:
+        telemetry.record_fused_collective(
+            "op:" + getattr(fn, "__name__", "op"), cid=node.cid
         )
     return node
+
+
+def defer_multi(fn, xs, **kw):
+    """Record a multi-output global-view op (a tuple-returning kernel like
+    CholQR2's (Q, R, ok)) over DNDarray/array operands as selector nodes —
+    :func:`defer_op`'s :func:`record_multi` form. Returns the tuple of
+    LazyArray selectors, or None to decline."""
+    if DNDarray is None:
+        _resolve_siblings()
+    if not (_ENABLED and _COLLECTIVES):
+        return None
+    if getattr(fn, "_no_fusion", False):
+        return _unfused("multi", "no_fusion_op")
+    if not hashable_kwargs(kw):
+        return _unfused("multi", "unhashable_kwargs")
+    try:
+        # _logical_node records the un-pad slice: inside the guard, like
+        # defer_reduce — a record-time failure there falls back to eager
+        children = _operand_children("multi", xs)
+        if children is None:
+            return None
+        nodes = record_multi(fn, tuple(children), **kw)
+    except Exception as exc:  # narrowed: ONE policy decides what falls back
+        if not resilience.record_recoverable(exc):
+            raise
+        return _unfused("multi", "record_failed:" + type(exc).__name__)
+    if telemetry._MODE:
+        telemetry.record_fused_collective(
+            "multi:" + getattr(fn, "__name__", "op"), cid=nodes[0].cid
+        )
+    return nodes
+
+
+def defer_matmul(a, b):
+    """Record a 2-D ``a @ b`` as a collective DAG node: the nine
+    split-combination schedules of the matmul case table
+    (``linalg.basics._matmul_program``) become sharding constraints on a
+    ``jnp.matmul`` node, so the contraction's psum/allgather compiles INTO
+    the enclosing chain's program instead of forcing it. Pending operands
+    stay pending. Returns the wrapped DNDarray at the case table's output
+    split, or None to decline (N-D, padded or tracer operands, mixed comms,
+    record failures → the eager pinned-program path).
+
+    The ``collective.matmul`` fault site is the CALLER's (``matmul`` checks
+    it before either path dispatches, like ``resplit_`` does for
+    ``collective.reshard``); this function only records."""
+    if DNDarray is None:
+        _resolve_siblings()
+    if not (_ENABLED and _COLLECTIVES):
+        return None
+    if a.ndim != 2 or b.ndim != 2:
+        return _unfused("matmul", "non_2d")
+    if a.comm is not b.comm:
+        return _unfused("matmul", "mixed_comm")
+    if a.padded or b.padded:
+        return _unfused("matmul", "padded_operand")
+    an, bn = _phys_node(a), _phys_node(b)
+    if an is None or bn is None:
+        return _unfused("matmul", "tracer_payload")
+    # the case table: split-0 lhs keeps rows local (out split 0); split-1
+    # rhs keeps cols local (out split 1); everything else contracts into a
+    # replicated result
+    if a.split == 0:
+        out_split = 0
+    elif b.split == 1:
+        out_split = 1
+    else:
+        out_split = None
+    comm = a.comm
+    try:
+        node = record(
+            _matmul_op,
+            (an, bn),
+            a_sharding=comm.sharding(2, a.split),
+            b_sharding=comm.sharding(2, b.split),
+            out_sharding=comm.sharding(2, out_split),
+        )
+    except Exception as exc:  # narrowed: ONE policy decides what falls back
+        if not resilience.record_recoverable(exc):
+            raise
+        return _unfused("matmul", "record_failed:" + type(exc).__name__)
+    if telemetry._MODE:
+        telemetry.record_fused_collective(
+            "matmul",
+            cid=node.cid,
+            detail=f"{a.split}x{b.split}->{out_split}",
+        )
+    return _wrap(node, (int(a.shape[0]), int(b.shape[1])), out_split, a)
 
 
 def programs() -> dict:

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
-from .. import factories, sanitation, types
+from .. import factories, fusion, resilience, sanitation, types
 from .._operations import __binary_op as _binary_op
 from ..communication import sanitize_comm
 from ..dndarray import DNDarray, _ensure_split
@@ -105,6 +105,18 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
         return dot(a, b)
 
     if a.ndim == 2 and b.ndim == 2 and not a.padded and not b.padded:
+        # the collective.matmul fault site fires before EITHER path
+        # dispatches (the resplit_ precedent): guarded forcing can
+        # degrade/replay the contraction like any other collective
+        if resilience._ARMED:
+            resilience.check("collective.matmul")
+        # deferred-first: the case table records as a collective DAG node
+        # (pending operands stay pending; the contraction's psum/allgather
+        # compiles into the enclosing chain's program). None → the
+        # schedule-pinned eager program (collectives off, tracers, ...).
+        deferred = fusion.defer_matmul(a, b)
+        if deferred is not None:
+            return deferred
         # schedule-pinned path: out split per the case table; unpadded
         # operands guarantee the out dim is divisible whenever it inherits
         # a split from an operand
@@ -145,13 +157,15 @@ def dot(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None) -> Union[DNDar
         result = jnp.dot(a.larray, b.larray)
         ret = _wrap_like(result, None, a)
         if out is not None:
-            out._replace(ret.larray, None)
+            out._adopt(ret)
             return out
         return ret
     if a.ndim <= 2 and b.ndim <= 2:
         ret = matmul(a, b)
         if out is not None:
-            out._replace(ret.larray, ret.split)
+            # adopt, don't force: a deferred matmul stays one pending chain
+            # through the out= seam
+            out._adopt(ret)
             return out
         return ret
     raise NotImplementedError("ht.dot not implemented for N-D dot M-D arrays")

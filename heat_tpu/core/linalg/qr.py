@@ -124,25 +124,39 @@ def qr(
     ):
         # try the MXU-native CholeskyQR2, fall back to Householder on the
         # breakdown/conditioning probe (one host scalar read; the probe also
-        # catches finite-but-degraded orthogonality, see _cholqr2_kernel)
-        with _T_COLLECTIVE:
-            q_try, r_try, ok = _cholqr2_kernel(a.larray, calc_q)
-        _record_cholqr2_collectives(a)  # the Gram psums ran either way
-        if bool(ok):
-            q_arr, r_arr = q_try, r_try
+        # catches finite-but-degraded orthogonality, see _cholqr2_kernel).
+        # Deferred-first: the passes record as a multi-output collective
+        # node, the probe read forces Q/R/ok in ONE dispatch, and a pending
+        # operand chain compiles into the same program.
+        deferred = _cholqr2_deferred(a, calc_q)
+        if deferred is not None:
+            q_d, r_d, ok_d = deferred
+            if ok_d:
+                if not calc_q:
+                    return QR(None, r_d)
+                return QR(q_d, r_d)
+        else:
+            with _T_COLLECTIVE:
+                q_try, r_try, ok = _cholqr2_kernel(a.larray, calc_q)
+            _record_cholqr2_collectives(a)  # the Gram psums ran either way
+            if bool(ok):
+                q_arr, r_arr = q_try, r_try
     elif method == "cholqr2":
         if m < n:
             raise ValueError(f"cholqr2 requires a tall operand (m >= n), got {a.shape}")
+        deferred = _cholqr2_deferred(a, calc_q)
+        if deferred is not None:
+            q_d, r_d, ok_d = deferred
+            if not ok_d:
+                raise ValueError(_CHOLQR2_BREAKDOWN_MSG)
+            if not calc_q:
+                return QR(None, r_d)
+            return QR(q_d, r_d)
         with _T_COLLECTIVE:
             q_arr, r_arr, ok = _cholqr2_kernel(a.larray, calc_q)
         _record_cholqr2_collectives(a)
         if not bool(ok):
-            raise ValueError(
-                "cholqr2 broke down (non-finite Cholesky of the Gram matrix, or "
-                "first-pass orthogonality error ‖Q1ᴴQ1 − I‖ >= 0.5): the operand "
-                "is rank-deficient or too ill-conditioned (cond ≳ 1/√ε) for the "
-                "squared-condition first pass — use method='tsqr'"
-            )
+            raise ValueError(_CHOLQR2_BREAKDOWN_MSG)
 
     if r_arr is None:  # no CholeskyQR2 result: Householder dispatch
         # TSQR needs a full (n, n) R per block: block = ceil(m/p) >= n,
@@ -150,6 +164,14 @@ def qr(
         # operand volume — exactly the silent gather the explicit fallback
         # policy exists to avoid
         if a.split == 0 and p > 1 and m >= n and -(-m // p) >= n:
+            deferred = _tsqr_deferred(a, comm)
+            if deferred is not None:
+                q_d, r_d = deferred
+                if not calc_q:
+                    # the unused Q pick is never walked into a program, so
+                    # XLA dead-code-eliminates the formation matmul
+                    return QR(None, r_d)
+                return QR(q_d, r_d)
             q_arr, r_arr = _tsqr(a, comm)
         elif a.split == 1 and p > 1 and m >= n:
             q_arr, r_arr = _panel_qr_split1(a, comm)
@@ -205,6 +227,102 @@ def _record_cholqr2_collectives(a: DNDarray) -> None:
     acc = jnp.result_type(a.larray.dtype, jnp.float32)
     telemetry.record_collective(
         "allreduce", a.comm.axis_name, n * n * jnp.dtype(acc).itemsize, str(acc), count=2
+    )
+
+
+_CHOLQR2_BREAKDOWN_MSG = (
+    "cholqr2 broke down (non-finite Cholesky of the Gram matrix, or "
+    "first-pass orthogonality error ‖Q1ᴴQ1 − I‖ >= 0.5): the operand "
+    "is rank-deficient or too ill-conditioned (cond ≳ 1/√ε) for the "
+    "squared-condition first pass — use method='tsqr'"
+)
+
+
+def _cholqr2_deferred(a: DNDarray, calc_q: bool):
+    """Record the CholeskyQR2 passes as a multi-output collective node: the
+    Gram psums compile into the producing chain's program, and the breakdown
+    probe's ONE host read forces Q/R/ok together (sibling batching — one
+    dispatch, one blocking sync). Returns ``(q, r, ok)`` with Q/R as DNDarray
+    wrappers (Q None when ``calc_q=False``), or None to decline (collectives
+    off, tracer payloads, record failures → the eager jitted kernel)."""
+    from .. import fusion
+
+    if not fusion.collectives_active():
+        return None
+    nodes = fusion.defer_multi(_cholqr2_op, (a,), calc_q=calc_q)
+    if nodes is None:
+        return None
+    _record_cholqr2_collectives(a)  # the Gram psums ride the dispatch
+    m, n = (int(s) for s in a.shape)
+    if calc_q:
+        qn, rn, okn = nodes
+        q = fusion.wrap_node(qn, (m, n), a.split, a)
+    else:
+        rn, okn = nodes
+        q = None
+    r = fusion.wrap_node(rn, (n, n), None, a)
+    with _T_COLLECTIVE:
+        ok = fusion.force(okn)
+    return q, r, bool(ok)
+
+
+@functools.lru_cache(maxsize=None)
+def _tsqr_kernel(axis: str, block: int, n: int, p: int):
+    """The TSQR reduction tree as an UNJITTED multi-output kernel for the
+    deferred path — the same schedule as :func:`_tsqr_program`, handed to
+    ``fusion.defer_apply`` so the R-stack all_gather compiles INTO the
+    enclosing chain's program. Cached for one function identity per layout
+    (one program-cache key)."""
+    k1 = min(block, n)
+
+    def kernel(xs):  # xs: (block, n) per device
+        q1, r1 = jnp.linalg.qr(xs, mode="reduced")  # (block, k1), (k1, n)
+        rs = jax.lax.all_gather(r1, axis)  # (p, k1, n) — the one ICI collective
+        q2, r = jnp.linalg.qr(rs.reshape(p * k1, n), mode="reduced")
+        idx = jax.lax.axis_index(axis)
+        q2_block = jax.lax.dynamic_slice_in_dim(q2, idx * k1, k1, axis=0)
+        return q1 @ q2_block, r
+
+    kernel.__name__ = f"tsqr_b{block}_n{n}"
+    return kernel
+
+
+def _tsqr_deferred(a: DNDarray, comm):
+    """Record TSQR as a multi-output collective node (Q row-split, R
+    replicated) — pending operand chains stay pending and the allgather
+    compiles into their program. Returns ``(q, r)`` DNDarray wrappers, or
+    None to decline (collectives off, ragged rows → the eager pad+mask
+    path, tracer payloads, record failures)."""
+    from .. import fusion
+
+    if not fusion.collectives_active() or a.padded:
+        return None
+    m, n = (int(s) for s in a.shape)
+    p = comm.size
+    block = m // p  # unpadded row split: m divides evenly
+    nodes = fusion.defer_apply(
+        comm,
+        _tsqr_kernel(comm.axis_name, block, n, p),
+        (a,),
+        in_splits=(0,),
+        out_split=(0, None),
+        check_vma=False,
+    )
+    if nodes is None:
+        return None
+    if resilience._ARMED:
+        # the declared schedule's fault site (one in-kernel all_gather) —
+        # record time is dispatch time for deferred kernels
+        resilience.check("collective.allgather")
+    if telemetry._MODE:
+        k1 = min(block, n)
+        itemsize = jnp.dtype(a.dtype.jax_type()).itemsize
+        telemetry.record_collective(
+            "allgather", comm.axis_name, p * k1 * n * itemsize, str(a.dtype.jax_type())
+        )
+    return (
+        fusion.wrap_node(nodes[0], (m, n), 0, a),
+        fusion.wrap_node(nodes[1], (n, n), None, a),
     )
 
 
@@ -364,9 +482,11 @@ def _panel_qr_split1(a: DNDarray, comm) -> Tuple[jax.Array, jax.Array]:
     return q_pad, r_pad
 
 
-@functools.partial(jax.jit, static_argnames=("calc_q",))
-def _cholqr2_kernel(x, calc_q: bool = True):
-    """Two CholeskyQR passes, one XLA program, returning ``(q, r, ok)``.
+def _cholqr2_body(x, calc_q: bool = True):
+    """Two CholeskyQR passes returning ``(q, r, ok)`` — the UNJITTED body
+    shared by the eager jitted wrapper (:func:`_cholqr2_kernel`) and the
+    deferred recording (:func:`_cholqr2_op`), so both paths run the exact
+    same arithmetic.
 
     Everything tall is a matmul: the Gram contractions run on the MXU (and
     GSPMD turns them into psums over the split axis), and Q formation is
@@ -432,6 +552,21 @@ def _cholqr2_kernel(x, calc_q: bool = True):
     ok = _cholqr2_probe_ok(r1, r2, g2, eye)
     q2 = form_q(q1, inv_upper(r2)) if calc_q else None
     return q2, r2 @ r1, ok
+
+
+_cholqr2_kernel = functools.partial(jax.jit, static_argnames=("calc_q",))(_cholqr2_body)
+
+
+def _cholqr2_op(x, *, calc_q):
+    """CholeskyQR2 as a recordable multi-output DAG op: the same body, with
+    the calc_q=False tuple flattened (record_multi infers one aval per
+    output, so None cannot ride the tuple). Under the fused program the
+    Gram contractions see the sharded operand and GSPMD inserts the same
+    psums the eager jitted kernel gets."""
+    q, r, ok = _cholqr2_body(x, calc_q)
+    if calc_q:
+        return q, r, ok
+    return r, ok
 
 
 def _cholqr2_probe_ok(r1, r2, g2, eye):

@@ -20,7 +20,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from .. import factories, resilience, sanitation, telemetry
+from .. import factories, fusion, resilience, sanitation, telemetry
 from ..dndarray import DNDarray
 from .basics import dot, matmul, norm, transpose
 
@@ -29,10 +29,12 @@ _T_COLLECTIVE = telemetry.force_trigger("collective")
 __all__ = ["cg", "eigh", "eigvalsh", "lanczos", "solve", "solve_triangular"]
 
 
-@jax.jit
-def _cg_fused(Al, bl, x0l):
+def _cg_body(Al, bl, x0l):
     """Whole CG run as one XLA program: the convergence test lives on device
-    inside the while_loop, so there is no per-iteration host round-trip."""
+    inside the while_loop, so there is no per-iteration host round-trip.
+    Unjitted body shared by the eager jitted wrapper (``_cg_fused``) and the
+    deferred recording (``fusion.defer_op``) — a pending operand chain then
+    compiles into the same program as the whole CG sweep."""
     n = bl.shape[0]
     r0 = bl - Al @ x0l
     rs0 = r0 @ r0
@@ -55,6 +57,9 @@ def _cg_fused(Al, bl, x0l):
     return x
 
 
+_cg_fused = jax.jit(_cg_body)
+
+
 def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -> DNDarray:
     """Conjugate gradients for s.p.d. ``A`` (reference solver.py:13-65)."""
     if not isinstance(A, DNDarray) or not isinstance(b, DNDarray) or not isinstance(x0, DNDarray):
@@ -66,39 +71,35 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
     if x0.ndim != 1:
         raise RuntimeError("c needs to be a 1D vector")
 
+    # deferred: record the whole CG sweep as ONE collective DAG node over the
+    # operands' LOGICAL views — a pending chain producing A/b/x0 (normal
+    # equations, preconditioner setup, ...) compiles into the SAME program as
+    # the sweep, and the result stays pending for the consumer's read
+    node = fusion.defer_op(_cg_body, (A, b, x0))
+    if node is not None:
+        x = fusion.wrap_node(node, tuple(b.shape), None, x0)
+        x.resplit_(x0.split)
+        if out is not None:
+            out._adopt(x)
+            return out
+        return x
+
     xl = _cg_fused(A.larray, b.larray, x0.larray)
     x = factories.array(xl, is_split=None, device=x0.device, comm=x0.comm)
     x.resplit_(x0.split)
     if out is not None:
-        out._replace(x.larray, x.split)
+        out._adopt(x)
         return out
     return x
 
 
 @functools.lru_cache(maxsize=None)
-def _tri_solve_program(mesh, axis, p, n, k, rows_loc, n_stages, owners, lower, dtype_name):
-    """Fused distributed blocked substitution (one jitted shard_map program).
-
-    ``A`` arrives as the PHYSICAL split-0 payload ``(p*rows_loc, n)`` —
-    rows padded per the dndarray.parray contract — and ``b`` zero-padded to
-    the same leading extent. The sweep runs ``n_stages`` stages inside a
-    ``fori_loop`` (program size is O(1) in ``p`` — the compile-time-scaling
-    requirement); stage ``t``:
-
-      1. the diagonal owner (``owners[t]`` — the SquareDiagTiles ownership
-         grid) solves its ``(rows_loc, rows_loc)`` diagonal tile against its
-         current local rhs with the XLA triangular kernel,
-      2. ONE psum of the solved ``(rows_loc, k)`` block replicates it,
-      3. every device folds ``A[:, tile t] @ x_t`` out of its local rhs —
-         the off-diagonal update, an MXU matmul with zero communication.
-
-    Collective budget: ``n_stages`` psums of ``rows_loc * k`` elements —
-    exactly one solved block each, never the operand (asserted by
-    tests/test_linalg_depth HLO budgets). Pad rows are sanitized to identity
-    rows inside the kernel, so their solution is the zero pad of ``b``.
-    """
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
+def _tri_solve_kernel(axis, p, n, k, rows_loc, n_stages, owners, lower, dtype_name):
+    """The blocked-substitution device function, UNJITTED — shared by the
+    eager jitted wrapper (:func:`_tri_solve_program`) and the deferred
+    recording (``fusion.defer_apply``), so both execute the identical
+    per-stage psum schedule. See :func:`_tri_solve_program` for the stage
+    anatomy and the collective budget."""
     dtype = jnp.dtype(dtype_name)
     n_pad = p * rows_loc
 
@@ -133,6 +134,36 @@ def _tri_solve_program(mesh, axis, p, n, k, rows_loc, n_stages, owners, lower, d
         _, x_own = jax.lax.fori_loop(0, n_stages, stage, (rhs0, x0))
         return x_own
 
+    device_fn.__name__ = f"tri_solve_p{p}_n{n}_k{k}"
+    return device_fn
+
+
+@functools.lru_cache(maxsize=None)
+def _tri_solve_program(mesh, axis, p, n, k, rows_loc, n_stages, owners, lower, dtype_name):
+    """Fused distributed blocked substitution (one jitted shard_map program).
+
+    ``A`` arrives as the PHYSICAL split-0 payload ``(p*rows_loc, n)`` —
+    rows padded per the dndarray.parray contract — and ``b`` zero-padded to
+    the same leading extent. The sweep runs ``n_stages`` stages inside a
+    ``fori_loop`` (program size is O(1) in ``p`` — the compile-time-scaling
+    requirement); stage ``t``:
+
+      1. the diagonal owner (``owners[t]`` — the SquareDiagTiles ownership
+         grid) solves its ``(rows_loc, rows_loc)`` diagonal tile against its
+         current local rhs with the XLA triangular kernel,
+      2. ONE psum of the solved ``(rows_loc, k)`` block replicates it,
+      3. every device folds ``A[:, tile t] @ x_t`` out of its local rhs —
+         the off-diagonal update, an MXU matmul with zero communication.
+
+    Collective budget: ``n_stages`` psums of ``rows_loc * k`` elements —
+    exactly one solved block each, never the operand (asserted by
+    tests/test_linalg_depth HLO budgets). Pad rows are sanitized to identity
+    rows inside the kernel, so their solution is the zero pad of ``b``.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    device_fn = _tri_solve_kernel(axis, p, n, k, rows_loc, n_stages, owners, lower, dtype_name)
+
     sharded = NamedSharding(mesh, P(axis, None))
 
     @functools.partial(jax.jit, in_shardings=(sharded, sharded), out_shardings=sharded)
@@ -146,6 +177,78 @@ def _tri_solve_program(mesh, axis, p, n, k, rows_loc, n_stages, owners, lower, d
         )(A_phys, b_pad)
 
     return run
+
+
+def _colvec_op(x):
+    return x[:, None]
+
+
+def _squeeze_col_op(x):
+    return x[:, 0]
+
+
+def _tri_solve_deferred(A: DNDarray, b: DNDarray, lower: bool):
+    """Record the split-0 blocked substitution as a collective DAG node; None
+    declines (collectives off, ragged operands, tracer payloads) back to the
+    eager jitted program. Block-aligned operands only: the physical payload
+    IS the logical one, so no pad/sanitize staging is needed and a pending
+    producer chain (e.g. QR's R inside ``solve``) fuses straight into the
+    substitution sweep."""
+    if not fusion.collectives_active():
+        return None
+    n = int(A.shape[0])
+    comm = A.comm
+    p = comm.size
+    if A.padded or b.padded or n % p != 0:
+        return None
+    vector_rhs = b.ndim == 1
+    dtype = jnp.result_type(A.dtype.jax_type(), b.dtype.jax_type(), jnp.float32)
+
+    from ._blocked import stage_grid
+
+    p, rows_loc, n_stages, owners = stage_grid(A)
+    if p * rows_loc != n:
+        return None
+
+    bn = fusion.phys_node(b)
+    if bn is None:
+        return None
+    k = 1 if vector_rhs else int(b.shape[1])
+    kernel = _tri_solve_kernel(
+        comm.axis_name, p, n, k, rows_loc, n_stages, owners, bool(lower), dtype.name
+    )
+    try:
+        bn = fusion.cast(bn, dtype)
+        if vector_rhs:
+            bn = fusion.record(_colvec_op, (bn,))
+        node = fusion.defer_apply(
+            comm, kernel, (A, bn), in_splits=(0, 0), out_split=0, check_vma=False
+        )
+        if node is None:
+            return None
+        if vector_rhs:
+            node = fusion.record(_squeeze_col_op, (node,))
+    except Exception as exc:  # narrowed: ONE policy decides what falls back
+        if not resilience.record_recoverable(exc):
+            raise
+        return None
+    if resilience._ARMED:
+        # the declared schedule's fault site (per-stage in-kernel psums)
+        resilience.check("collective.allreduce")
+    if telemetry._MODE:
+        # declared schedule: one psum of one solved (rows_loc, k) block per stage
+        telemetry.record_collective(
+            "allreduce",
+            comm.axis_name,
+            rows_loc * k * jnp.dtype(dtype).itemsize,
+            dtype.name,
+            count=n_stages,
+        )
+    gshape = (n,) if vector_rhs else (n, k)
+    out = fusion.wrap_node(node, gshape, 0, b)
+    if b.split != 0:
+        out.resplit_(b.split)
+    return out
 
 
 def solve_triangular(A: DNDarray, b: DNDarray, lower: bool = False) -> DNDarray:
@@ -170,16 +273,8 @@ def solve_triangular(A: DNDarray, b: DNDarray, lower: bool = False) -> DNDarray:
         raise ValueError("b's leading dimension must match A")
 
     n = int(A.shape[0])
-    if A.split is not None and A.comm.size > 1:
-        # first payload access on the distributed path: pending chains force
-        # here and attribute to the collective schedule below; the local
-        # branch runs zero collectives and keeps plain larray attribution
-        with _T_COLLECTIVE:
-            dtype = jnp.result_type(A.larray.dtype, b.larray.dtype, jnp.float32)
-    else:
-        dtype = jnp.result_type(A.larray.dtype, b.larray.dtype, jnp.float32)
-
     if A.split is None or A.comm.size == 1:
+        dtype = jnp.result_type(A.larray.dtype, b.larray.dtype, jnp.float32)
         bl = b.larray.astype(dtype)
         if vector_rhs:
             bl = bl[:, None]
@@ -194,6 +289,17 @@ def solve_triangular(A: DNDarray, b: DNDarray, lower: bool = False) -> DNDarray:
         from ..manipulations import resplit as _resplit
 
         A = _resplit(A, 0)
+
+    # deferred-first: block-aligned operands record the sweep as a collective
+    # DAG node (operands stay pending; decline falls through to eager)
+    deferred = _tri_solve_deferred(A, b, bool(lower))
+    if deferred is not None:
+        return deferred
+
+    # first payload access on the distributed eager path: pending chains
+    # force here and attribute to the collective schedule below
+    with _T_COLLECTIVE:
+        dtype = jnp.result_type(A.larray.dtype, b.larray.dtype, jnp.float32)
 
     comm = A.comm
     # stage grid + diagonal ownership from the tile decomposition (one tile

@@ -18,10 +18,24 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from . import factories, sanitation, types
+from . import factories, fusion, sanitation, types
 from .dndarray import DNDarray, _ensure_split
 
 __all__ = ["convolve"]
+
+
+@functools.lru_cache(maxsize=None)
+def _halo_conv_kernel(k: int):
+    """The stencil as an UNJITTED kernel over the deferred halo pair: each
+    device concatenates ``[prev | local | next]`` and convolves locally
+    (overlap-save). Recorded through ``fusion.defer_apply`` so the halo
+    exchange AND the conv compile into the producing chain's one program."""
+
+    def kernel(prev, x, nxt, v):  # (h,), (block,), (h,), (k,) -> (block,)
+        return jnp.convolve(jnp.concatenate([prev, x, nxt]), v, mode="valid")
+
+    kernel.__name__ = f"halo_conv_k{k}"
+    return kernel
 
 
 @functools.lru_cache(maxsize=None)
@@ -84,6 +98,20 @@ def convolve(a, v, mode: str = "full") -> DNDarray:
         vl = v.larray.astype(promoted.jax_type())
         h = k // 2
         a.get_halo(h)
+        halos = a._halo_wrappers()
+        if halos is not None:
+            # deferred stencil: get_halo recorded the ppermute pair — record
+            # the local conv against it, so chain → exchange → conv is ONE
+            # cached program forced at the consumer's read
+            node = fusion.defer_apply(
+                a.comm,
+                _halo_conv_kernel(k),
+                (halos[0], a, halos[1], vl),
+                in_splits=(0, 0, 0, None),
+                out_split=0,
+            )
+            if node is not None:
+                return fusion.wrap_node(node, (n,), 0, a)
         ext_global = a.array_with_halos  # (p * (block + 2h),)
         fn = _halo_conv_program(
             a.comm.mesh, a.comm.axis_name, n // p + 2 * h, k, str(ext_global.dtype)

@@ -71,6 +71,56 @@ assert doc["attribution"]["unattributed_pct"] <= 5.0, \
 assert doc["findings"] == [], f"clean workload produced findings: {doc['findings']}"
 print("analyze OK:", {b: rec["pct"] for b, rec in doc["attribution"]["overall"].items()})
 PY
+# whole-algorithm fusion leg (ISSUE 20): the estimator suites run with
+# collective fusion on (the default), then a real reduce-then-matmul
+# iteration loop is traced and budget-checked — steady state must be ONE
+# program dispatch and at most one blocking sync per iteration — and the
+# trace goes through the post-hoc analyzer with ZERO findings; the same
+# suites stay green under HEAT_TPU_FUSION_COLLECTIVES=0 and the ambient
+# fault mix (deferral must not change results or swallow faults)
+echo "=== whole-algorithm fusion (estimator suites + dispatch/sync budget) ==="
+python -m pytest tests/test_whole_algorithm_fusion.py tests/test_lloyd_fused.py \
+  tests/test_ml.py -q -x
+HEAT_TPU_TELEMETRY=verbose python - <<'PY'
+import numpy as np
+import heat_tpu as ht
+from heat_tpu.core import telemetry
+
+p = ht.get_comm().size
+rng = np.random.default_rng(0)
+x = ht.array(rng.standard_normal((8 * p, 4 * p)).astype(np.float32), split=0)
+w = ht.array(rng.standard_normal((4 * p, 2 * p)).astype(np.float32))
+
+def step():
+    mu = ht.mean(x)  # split-crossing psum node
+    return float(ht.sum((x - mu) @ w))  # matmul node + reduction, ONE read
+
+step(); step()  # warm: compiles land, steady state begins
+telemetry.reset()
+iters = 5
+for _ in range(iters):
+    step()
+stats = telemetry.async_forcing()
+assert stats["dispatches"] == iters, f"not 1 dispatch/iteration: {stats}"
+assert stats["blocking_total"] <= iters, f">1 blocking sync/iteration: {stats}"
+telemetry.export_trace("/tmp/heat_tpu_whole_algo_trace.json")
+print("whole-algorithm budget OK:", {k: stats[k] for k in
+      ("dispatches", "blocking_total", "multi_root_batches")})
+PY
+python -m heat_tpu.telemetry analyze /tmp/heat_tpu_whole_algo_trace.json --json \
+  > /tmp/heat_tpu_whole_algo_analysis.json
+python - <<'PY'
+import json
+doc = json.load(open("/tmp/heat_tpu_whole_algo_analysis.json"))
+assert doc["findings"] == [], \
+    f"whole-algorithm trace produced findings: {doc['findings']}"
+print("whole-algorithm analyze OK")
+PY
+echo "=== whole-algorithm fusion: collectives-off + faults legs ==="
+HEAT_TPU_FUSION_COLLECTIVES=0 \
+  python -m pytest tests/test_whole_algorithm_fusion.py tests/test_lloyd_fused.py -q -x
+HEAT_TPU_FAULTS=ci HEAT_TPU_TELEMETRY=1 \
+  python -m pytest tests/test_whole_algorithm_fusion.py -q -x
 # memory-observability leg: the headroom admission gate is ARMED (a generous
 # fraction of host memory under the warn policy — every fused dispatch pays
 # the live-ledger check without any policy actually firing) while the memory

@@ -108,9 +108,13 @@ class TestMatmulSchedule(TestCase):
             self.assertLessEqual(vol, m * k, f"collective exceeds the left factor: {colls}")
 
     def test_matmul_uses_pinned_program(self):
-        # the runtime path must route 2-D divisible matmuls through
-        # _matmul_program (cache hit proves it)
+        # the EAGER runtime path must route 2-D divisible matmuls through
+        # _matmul_program (cache hit proves it). Collective deferral is
+        # switched off here because the default path now records a matmul
+        # DAG node instead (pinned by tests/test_whole_algorithm_fusion.py);
+        # this pin guards the collectives-off/fallback engine.
         from heat_tpu.core.linalg.basics import _matmul_program
+        from heat_tpu.core import fusion
 
         p = self.get_size()
         rng = np.random.default_rng(0)
@@ -119,7 +123,8 @@ class TestMatmulSchedule(TestCase):
         a = ht.array(a_np, split=0)
         b = ht.array(b_np, split=None)
         before = _matmul_program.cache_info().currsize
-        out = a @ b
+        with fusion.collectives_disabled():
+            out = a @ b
         after_info = _matmul_program.cache_info()
         self.assertGreaterEqual(after_info.currsize + after_info.hits, max(before, 1))
         np.testing.assert_allclose(out.numpy(), a_np @ b_np, rtol=1e-4)
